@@ -1,0 +1,11 @@
+"""Tier-0 compute kernels: batched big-integer / modular arithmetic on TPU."""
+
+from dds_tpu.ops.bignum import (  # noqa: F401
+    LIMB_BITS,
+    LIMB_MASK,
+    int_to_limbs,
+    limbs_to_int,
+    ints_to_batch,
+    batch_to_ints,
+)
+from dds_tpu.ops.montgomery import ModCtx  # noqa: F401

@@ -1,0 +1,141 @@
+"""Fixed-shape big-integer limb arithmetic for TPU.
+
+Big integers are represented as ``(batch, L)`` arrays of ``uint32`` holding
+16-bit limbs, **little-endian** (limb 0 is the least significant 16 bits).
+16-bit limbs are the TPU-friendly digit size: a full 16x16 product fits a
+single native ``uint32`` multiply (no 64-bit widening, which the TPU vector
+unit does not have), and carry chains can be kept *redundant* (limbs are
+allowed to exceed 16 bits between normalization passes) so everything
+vectorizes over both the batch and limb axes.
+
+This replaces the JVM ``BigInteger`` arithmetic that is the compute hot spot
+of the reference system (Paillier/RSA modmul + modexp inside
+``hlib.hj.mlib``, consumed via ``utils/SJHomoLibProvider.scala:53-71`` and the
+proxy aggregate folds at ``dds/http/DDSRestServer.scala:385,423,479,518``).
+Nothing here mirrors JVM code: the representation and algorithms are chosen
+for the TPU's 8x128 VPU (vectorized multiply/mask/shift) and XLA's static
+shapes (one compiled kernel per key size).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1  # 0xFFFF
+
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (python int <-> limb arrays)
+# ---------------------------------------------------------------------------
+
+def n_limbs_for_bits(bits: int) -> int:
+    """Number of 16-bit limbs needed for `bits`-bit integers."""
+    return -(-bits // LIMB_BITS)
+
+
+def int_to_limbs(x: int, L: int) -> np.ndarray:
+    """Python int -> little-endian uint32 array of L 16-bit limbs."""
+    if x < 0:
+        raise ValueError("negative ints not representable")
+    if x >> (LIMB_BITS * L):
+        raise ValueError(f"{x.bit_length()}-bit int does not fit {L} limbs")
+    b = x.to_bytes(2 * L, "little")
+    return np.frombuffer(b, dtype="<u2").astype(np.uint32)
+
+
+def limbs_to_int(arr) -> int:
+    """Little-endian limb array (canonical, limbs < 2^16) -> python int."""
+    a = np.asarray(arr, dtype=np.uint64)
+    out = 0
+    for i in range(a.shape[-1] - 1, -1, -1):
+        out = (out << LIMB_BITS) | int(a[i])
+    return out
+
+
+def ints_to_batch(xs, L: int) -> np.ndarray:
+    """List of python ints -> (B, L) uint32 limb batch."""
+    return np.stack([int_to_limbs(x, L) for x in xs], axis=0)
+
+
+def batch_to_ints(batch) -> list[int]:
+    b = np.asarray(batch)
+    return [limbs_to_int(b[i]) for i in range(b.shape[0])]
+
+
+# ---------------------------------------------------------------------------
+# Device-side primitives (all pure jnp, vectorized over batch & limb axes)
+# ---------------------------------------------------------------------------
+
+def normalize(t: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fully propagate carries -> canonical limbs (< 2^16).
+
+    ``t``: (B, K) uint32 with limbs < 2^32 - 2^16 (so limb + carry cannot
+    overflow uint32). Returns (canonical (B, K), carry_out (B,)).
+
+    Sequential over the K limb axis (a `lax.scan`) but vectorized over batch;
+    this is O(K) next to the O(K^2) multiply work, so it costs ~1/K.
+    """
+
+    def step(carry, col):
+        s = col + carry
+        return s >> LIMB_BITS, s & LIMB_MASK
+
+    carry, cols = jax.lax.scan(step, jnp.zeros(t.shape[0], jnp.uint32), t.T)
+    return cols.T, carry
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Canonical + canonical -> (canonical sum, carry_out). Shapes equal."""
+    return normalize(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """a - b with borrow propagation (canonical inputs).
+
+    Returns (diff (B, K) canonical, borrow_out (B,) — 1 where a < b, in which
+    case diff is the 2^(16K)-complement value).
+    """
+
+    def step(borrow, cols):
+        ai, bi = cols
+        d = ai.astype(jnp.int32) - bi.astype(jnp.int32) - borrow.astype(jnp.int32)
+        new_borrow = (d < 0).astype(jnp.uint32)
+        d = jnp.where(d < 0, d + (1 << LIMB_BITS), d).astype(jnp.uint32)
+        return new_borrow, d
+
+    borrow, cols = jax.lax.scan(
+        step, jnp.zeros(a.shape[0], jnp.uint32), (a.T, b.T)
+    )
+    return cols.T, borrow
+
+
+def cond_sub(t: jnp.ndarray, mod: jnp.ndarray) -> jnp.ndarray:
+    """Return t - mod where t >= mod else t (canonical t, (B,K); mod (K,))."""
+    diff, borrow = sub(t, jnp.broadcast_to(mod, t.shape))
+    return jnp.where((borrow == 1)[:, None], t, diff)
+
+
+def geq(a: jnp.ndarray, mod: jnp.ndarray) -> jnp.ndarray:
+    """(B,) bool: a >= mod (canonical limbs; mod (K,))."""
+    _, borrow = sub(a, jnp.broadcast_to(mod, a.shape))
+    return borrow == 0
+
+
+def scalar_mul_small(x: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Multiply canonical (B, K) limbs by per-row 16-bit scalars s (B,).
+
+    Returns canonical (B, K+1). Used for Paillier's (1 + m*n) fast path where
+    m has been limb-decomposed already; see models/paillier.py.
+    """
+    p = x * s[:, None]                       # each product < 2^32
+    lo = p & LIMB_MASK
+    hi = p >> LIMB_BITS
+    t = jnp.pad(lo, ((0, 0), (0, 1)))
+    t = t.at[:, 1:].add(hi)
+    out, carry = normalize(t)
+    # carry out of the top limb is impossible: value < 2^16 * 2^(16K)
+    del carry
+    return out

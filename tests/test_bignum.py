@@ -1,0 +1,124 @@
+"""Known-answer tests for tier-0 limb arithmetic vs python ints.
+
+The reference has no test suite (SURVEY.md §4); these are the unit layer of
+the test pyramid we add: every kernel is checked against `pow()` / int
+arithmetic on randomized operands at several key sizes.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from dds_tpu.ops import bignum as bn
+from dds_tpu.ops.montgomery import ModCtx
+
+rng = random.Random(0xDD5)
+
+
+def rand_odd(bits):
+    n = rng.getrandbits(bits) | (1 << (bits - 1)) | 1
+    return n
+
+
+def rand_below(n, k):
+    return [rng.randrange(n) for _ in range(k)]
+
+
+def test_limb_roundtrip():
+    for bits in (16, 64, 256, 2048):
+        L = bn.n_limbs_for_bits(bits)
+        xs = [rng.getrandbits(bits) for _ in range(5)] + [0, 1, (1 << bits) - 1]
+        batch = bn.ints_to_batch(xs, L)
+        assert bn.batch_to_ints(batch) == xs
+
+
+def test_add_sub():
+    L = 16
+    n = 1 << (16 * L)
+    a_int = [rng.randrange(n) for _ in range(8)]
+    b_int = [rng.randrange(n) for _ in range(8)]
+    a, b = bn.ints_to_batch(a_int, L), bn.ints_to_batch(b_int, L)
+    s, carry = bn.add(a, b)
+    for i in range(8):
+        total = a_int[i] + b_int[i]
+        assert bn.limbs_to_int(np.asarray(s)[i]) == total % n
+        assert int(carry[i]) == total // n
+    d, borrow = bn.sub(a, b)
+    for i in range(8):
+        diff = a_int[i] - b_int[i]
+        assert int(borrow[i]) == (1 if diff < 0 else 0)
+        assert bn.limbs_to_int(np.asarray(d)[i]) == diff % n
+
+
+@pytest.mark.parametrize("bits", [64, 256, 1024, 2048])
+def test_mont_mul(bits):
+    n = rand_odd(bits)
+    ctx = ModCtx.make(n)
+    B = 4
+    a_int, b_int = rand_below(n, B), rand_below(n, B)
+    a = bn.ints_to_batch(a_int, ctx.L)
+    b = bn.ints_to_batch(b_int, ctx.L)
+    out = ctx.mul_mod(a, b)
+    got = bn.batch_to_ints(out)
+    want = [(x * y) % n for x, y in zip(a_int, b_int)]
+    assert got == want
+
+
+@pytest.mark.parametrize("bits", [64, 256, 1024])
+def test_mont_domain_roundtrip(bits):
+    n = rand_odd(bits)
+    ctx = ModCtx.make(n)
+    xs = rand_below(n, 3) + [0, 1, n - 1]
+    x = bn.ints_to_batch(xs, ctx.L)
+    back = ctx.from_mont(ctx.to_mont(x))
+    assert bn.batch_to_ints(back) == xs
+
+
+@pytest.mark.parametrize("bits,ebits", [(64, 64), (256, 256), (1024, 64)])
+def test_mont_exp(bits, ebits):
+    n = rand_odd(bits)
+    ctx = ModCtx.make(n)
+    exp = rng.getrandbits(ebits)
+    xs = rand_below(n, 4)
+    x = bn.ints_to_batch(xs, ctx.L)
+    got = bn.batch_to_ints(ctx.pow_mod(x, exp))
+    assert got == [pow(v, exp, n) for v in xs]
+
+
+def test_mont_exp_edge_exponents():
+    n = rand_odd(256)
+    ctx = ModCtx.make(n)
+    xs = rand_below(n, 3)
+    x = bn.ints_to_batch(xs, ctx.L)
+    assert bn.batch_to_ints(ctx.pow_mod(x, 0)) == [1, 1, 1]
+    assert bn.batch_to_ints(ctx.pow_mod(x, 1)) == xs
+    assert bn.batch_to_ints(ctx.pow_mod(x, 2)) == [v * v % n for v in xs]
+    assert bn.batch_to_ints(ctx.pow_mod(x, 65537)) == [pow(v, 65537, n) for v in xs]
+
+
+@pytest.mark.parametrize("K", [1, 2, 3, 7, 8, 16, 33])
+def test_reduce_mul(K):
+    n = rand_odd(512)
+    ctx = ModCtx.make(n)
+    cs_int = rand_below(n, K)
+    cs = bn.ints_to_batch(cs_int, ctx.L)
+    got = bn.limbs_to_int(np.asarray(ctx.reduce_mul(cs))[0])
+    want = 1
+    for c in cs_int:
+        want = want * c % n
+    assert got == want
+
+
+def test_scalar_mul_small():
+    L = 16
+    n_max = 1 << (16 * L)
+    xs = [rng.randrange(n_max) for _ in range(4)]
+    ss = [rng.randrange(1 << 16) for _ in range(4)]
+    import jax.numpy as jnp
+
+    out = bn.scalar_mul_small(
+        bn.ints_to_batch(xs, L), jnp.asarray(np.array(ss, np.uint32))
+    )
+    for i in range(4):
+        assert bn.limbs_to_int(np.asarray(out)[i]) == xs[i] * ss[i]
