@@ -1,0 +1,12 @@
+"""Tier-2 replicated core: BFT-ABD quorum protocol over async transports."""
+
+from dds_tpu.core.messages import (  # noqa: F401
+    ABDTag,
+    Envelope,
+    IRead,
+    IWrite,
+    IReadReply,
+    IWriteReply,
+)
+from dds_tpu.core.replica import BFTABDNode, ReplicaConfig  # noqa: F401
+from dds_tpu.core.transport import InMemoryNet  # noqa: F401

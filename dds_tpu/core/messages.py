@@ -1,0 +1,227 @@
+"""Wire messages for the BFT-ABD protocol, supervisor, and proxy contract.
+
+Counterpart of the reference's three API files (`dds/api/ABDAPI.scala`,
+`InternalAPI.scala`, `SupervisorAPI.scala`) and the small data models under
+`dds/core/models/`. Serialization is tagged canonical JSON (language-neutral)
+instead of Java/Akka serialization.
+
+A "set" (the stored value) is a plain JSON list or None; tags order writes.
+Tag ordering deviation (documented per SURVEY.md §7): the reference breaks
+seq ties arbitrarily (`BFTABDNode.scala:185-188`); we order by (seq, id) —
+the standard ABD total order — so write-back is deterministic.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, fields
+from typing import Any, Optional
+
+DDSSet = list  # a stored record: JSON-safe list of column values
+
+
+@dataclass(frozen=True, order=True)
+class ABDTag:
+    seq: int
+    id: str
+
+
+# --------------------------------------------------------------------------
+# proxy <-> replica intermediate API (InternalAPI.scala)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class IRead:
+    key: str
+
+
+@dataclass(frozen=True)
+class IWrite:
+    key: str
+    set: Optional[DDSSet]
+
+
+@dataclass(frozen=True)
+class IReadReply:
+    key: str
+    set: Optional[DDSSet]
+
+
+@dataclass(frozen=True)
+class IWriteReply:
+    key: str
+
+
+@dataclass(frozen=True)
+class Envelope:
+    call: Any          # one of the I* messages above
+    nonce: int
+    signature: bytes
+
+
+# --------------------------------------------------------------------------
+# replica <-> replica ABD protocol (ABDAPI.scala)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ReadTag:
+    key: str
+    nonce: int
+
+
+@dataclass(frozen=True)
+class TagReply:
+    tag: ABDTag
+    key: str
+    value: Optional[DDSSet]
+    signature: bytes
+    nonce: int
+
+
+@dataclass(frozen=True)
+class Write:
+    tag: ABDTag
+    key: str
+    value: Optional[DDSSet]
+    signature: bytes
+    nonce: int
+
+
+@dataclass(frozen=True)
+class WriteAck:
+    key: str
+    nonce: int
+
+
+@dataclass(frozen=True)
+class Read:
+    key: str
+    nonce: int
+
+
+@dataclass(frozen=True)
+class ReadReply:
+    tag: ABDTag
+    key: str
+    value: Optional[DDSSet]
+    signature: bytes
+    nonce: int
+
+
+# --------------------------------------------------------------------------
+# supervisor protocol (SupervisorAPI.scala)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Suspect:
+    replica: str       # endpoint of the suspected replica
+    nonce: int
+
+
+@dataclass(frozen=True)
+class Awake:
+    pass
+
+
+@dataclass(frozen=True)
+class State:
+    data: dict         # key -> {"tag": [seq, id], "value": set|None}
+    nonces: list[int]
+
+
+@dataclass(frozen=True)
+class Sleep:
+    data: dict
+    nonces: list[int]
+
+
+@dataclass(frozen=True)
+class Complying:
+    pass
+
+
+@dataclass(frozen=True)
+class Kill:
+    """Control message: hard-restart the replica with empty state.
+
+    The reference uses Akka `Kill` + the guardian's restart strategy
+    (`BFTSupervisor.scala:115`, `BFTSupervisorStrategy.scala:8-10`); our
+    transport delivers an explicit control message the node host honors.
+    """
+
+
+@dataclass(frozen=True)
+class RequestReplicas:
+    pass
+
+
+@dataclass(frozen=True)
+class ActiveReplicas:
+    replicas: list[str]
+
+
+# --------------------------------------------------------------------------
+# fault injection backdoor (malicious/MaliciousAttack.scala:34)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Compromise:
+    pass
+
+
+# --------------------------------------------------------------------------
+# serialization: tagged canonical JSON
+# --------------------------------------------------------------------------
+
+_TYPES = {
+    cls.__name__: cls
+    for cls in (
+        IRead, IWrite, IReadReply, IWriteReply, Envelope,
+        ReadTag, TagReply, Write, WriteAck, Read, ReadReply,
+        Suspect, Awake, State, Sleep, Complying, Kill,
+        RequestReplicas, ActiveReplicas, Compromise,
+    )
+}
+
+
+def _enc(v):
+    if isinstance(v, bytes):
+        return {"__b64__": base64.b64encode(v).decode()}
+    if isinstance(v, ABDTag):
+        return {"__tag__": [v.seq, v.id]}
+    if type(v) in _TYPES.values():
+        return to_dict(v)
+    return v
+
+
+def _dec(v):
+    if isinstance(v, dict):
+        if "__b64__" in v:
+            return base64.b64decode(v["__b64__"])
+        if "__tag__" in v:
+            return ABDTag(int(v["__tag__"][0]), str(v["__tag__"][1]))
+        if "__msg__" in v:
+            return from_dict(v)
+    return v
+
+
+def to_dict(msg) -> dict:
+    d = {"__msg__": type(msg).__name__}
+    for f in fields(msg):
+        d[f.name] = _enc(getattr(msg, f.name))
+    return d
+
+
+def from_dict(d: dict):
+    cls = _TYPES[d["__msg__"]]
+    kwargs = {f.name: _dec(d[f.name]) for f in fields(cls)}
+    return cls(**kwargs)
+
+
+def dumps(msg) -> bytes:
+    return json.dumps(to_dict(msg), separators=(",", ":")).encode()
+
+
+def loads(raw: bytes):
+    return from_dict(json.loads(raw))

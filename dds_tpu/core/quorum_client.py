@@ -1,0 +1,149 @@
+"""Proxy-side ABD access: nonce-challenged, HMAC-verified quorum reads/writes.
+
+Counterpart of the `fetchSet` / `writeSet` functions inside the reference
+proxy (`dds/http/DDSRestServer.scala:952-1000, 1002-1050`): pick a random
+trusted replica as coordinator, send a signed `Envelope(IRead/IWrite)`,
+await the enveloped reply, and verify (a) the challenge nonce is the request
+nonce + increment, (b) the proxy HMAC over the reply, (c) the echoed key.
+Every failure increments local suspicion on the coordinator (3 strikes
+excludes it — `utils/TrustedNodesList.scala:23-29`) and raises a typed
+Byzantine exception.
+
+Reply correlation mirrors Akka ask semantics: a junk reply from the asked
+coordinator (wrong shape, bare message) resolves the outstanding request and
+is then rejected by validation, rather than stalling until timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+
+from dds_tpu.core import messages as M
+from dds_tpu.core.errors import (
+    ByzFailedNonceChallengeError,
+    ByzInvalidKeyError,
+    ByzInvalidSignatureError,
+    ByzUnknownReplyError,
+)
+from dds_tpu.core.transport import Transport
+from dds_tpu.utils import sigs
+from dds_tpu.utils.trust import TrustedNodesList
+
+log = logging.getLogger("dds.quorum_client")
+
+
+@dataclass
+class AbdClientConfig:
+    proxy_mac_secret: bytes = b"rest2abd"
+    nonce_increment: int = 1
+    request_timeout: float = 5.0
+    supervisor: str | None = None  # only accept ActiveReplicas from here
+
+
+class AbdClient:
+    def __init__(
+        self,
+        addr: str,
+        net: Transport,
+        replicas: list[str],
+        config: AbdClientConfig | None = None,
+    ):
+        self.addr = addr
+        self.net = net
+        self.cfg = config or AbdClientConfig()
+        self.replicas = TrustedNodesList(replicas)
+        # challenge nonce -> (future, coordinator)
+        self._pending: dict[int, tuple[asyncio.Future, str]] = {}
+        net.register(addr, self.handle)
+
+    async def handle(self, sender: str, msg) -> None:
+        if isinstance(msg, M.Envelope) and msg.nonce in self._pending:
+            fut, _ = self._pending[msg.nonce]
+            if not fut.done():
+                fut.set_result(msg)
+            return
+        if isinstance(msg, M.ActiveReplicas):
+            if self.cfg.supervisor is not None and sender != self.cfg.supervisor:
+                log.warning("ignoring ActiveReplicas from non-supervisor %s", sender)
+                return
+            if msg.replicas:
+                self.replicas.reset(msg.replicas)
+            return
+        # junk from a coordinator we are waiting on resolves that request
+        # (Akka-ask semantics); validation will reject it.
+        for nonce, (fut, coord) in list(self._pending.items()):
+            if coord == sender and not fut.done():
+                fut.set_result(msg)
+                return
+        log.debug("unmatched message from %s: %s", sender, type(msg).__name__)
+
+    async def _ask(self, call, nonce: int, signature: bytes):
+        coordinator = self.replicas.defer_to()
+        challenge = nonce + self.cfg.nonce_increment
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[challenge] = (fut, coordinator)
+        try:
+            self.net.send(self.addr, coordinator, M.Envelope(call, nonce, signature))
+            try:
+                reply = await asyncio.wait_for(fut, self.cfg.request_timeout)
+            except asyncio.TimeoutError:
+                self.replicas.increment_suspicion(coordinator)
+                raise
+            return reply, coordinator, challenge
+        finally:
+            self._pending.pop(challenge, None)
+
+    async def fetch_set(self, key: str):
+        """Quorum read; returns the stored set (list) or None."""
+        nonce = sigs.generate_nonce()
+        sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce)
+        reply, coord, challenge = await self._ask(M.IRead(key), nonce, sig)
+
+        cfg = self.cfg
+        match reply:
+            case M.Envelope(M.IReadReply(k, value), rnonce, rsig):
+                if rnonce != challenge:
+                    self.replicas.increment_suspicion(coord)
+                    raise ByzFailedNonceChallengeError(coord)
+                if not sigs.validate_proxy_signature(
+                    cfg.proxy_mac_secret, k, rnonce, rsig, value
+                ):
+                    self.replicas.increment_suspicion(coord)
+                    raise ByzInvalidSignatureError(coord)
+                if k != key:
+                    self.replicas.increment_suspicion(coord)
+                    raise ByzInvalidKeyError(coord)
+                return value
+            case _:
+                self.replicas.increment_suspicion(coord)
+                raise ByzUnknownReplyError(coord)
+
+    async def write_set(self, key: str, value) -> str:
+        """Quorum write (value=None removes); returns the key on success."""
+        nonce = sigs.generate_nonce()
+        sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce, value)
+        reply, coord, challenge = await self._ask(M.IWrite(key, value), nonce, sig)
+
+        cfg = self.cfg
+        match reply:
+            case M.Envelope(M.IWriteReply(k), rnonce, rsig):
+                if rnonce != challenge:
+                    self.replicas.increment_suspicion(coord)
+                    raise ByzFailedNonceChallengeError(coord)
+                if not sigs.validate_proxy_signature(cfg.proxy_mac_secret, k, rnonce, rsig):
+                    self.replicas.increment_suspicion(coord)
+                    raise ByzInvalidSignatureError(coord)
+                if k != key:
+                    self.replicas.increment_suspicion(coord)
+                    raise ByzInvalidKeyError(coord)
+                return k
+            case _:
+                self.replicas.increment_suspicion(coord)
+                raise ByzUnknownReplyError(coord)
+
+    def refresh_from(self, supervisor: str) -> None:
+        """Ask the supervisor for the freshest active replicas (fire & forget;
+        the `ActiveReplicas` reply lands in `handle`)."""
+        self.net.send(self.addr, supervisor, M.RequestReplicas())

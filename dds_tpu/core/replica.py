@@ -1,0 +1,379 @@
+"""BFT-ABD replica: quorum-replicated register with HMAC auth + anti-replay.
+
+Counterpart of `dds/core/BFTABDNode.scala` — same three behaviors
+(healthy / sentinent / byzantine), same two-phase quorum protocol, same
+suspicion triggers — re-expressed as a plain async message handler over the
+`core.transport` fabric instead of an Akka actor.
+
+Protocol summary (healthy):
+- proxy `Envelope(IWrite)` -> broadcast `ReadTag`; on quorum of `TagReply`
+  take max tag, bump seq, broadcast `Write`; on quorum of `WriteAck` answer
+  the proxy with `IWriteReply` under challenge nonce = client nonce + inc.
+- proxy `Envelope(IRead)` -> broadcast `Read`; on quorum of `ReadReply`
+  take max (tag, value, signature), broadcast write-back `Write` with the
+  *original* signature; on quorum of `WriteAck` answer `IReadReply`.
+- every inbound protocol message is HMAC-verified and nonce-replay-checked;
+  violations raise `Suspect` votes to the supervisor
+  (`BFTABDNode.scala:137,158,165,212,219,250,298,319,326`).
+
+Deviations (documented per SURVEY.md §7): tags order by (seq, id) rather
+than seq-with-arbitrary-tie-break; the ABD HMAC signs the true `tag.seq`
+(reference signs `seq + 1`, `Utils.scala:33`).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass, field
+
+from dds_tpu.core import messages as M
+from dds_tpu.core.transport import Transport
+from dds_tpu.utils import sigs
+from dds_tpu.utils.trust import TrustedNodesList
+
+log = logging.getLogger("dds.replica")
+
+
+@dataclass
+class ReplicaConfig:
+    quorum_size: int = 5
+    nonce_increment: int = 1
+    abd_mac_secret: bytes = b"intranet-abd-secret"
+    proxy_mac_secret: bytes = b"rest2abd"
+    debug: bool = False
+
+
+@dataclass
+class _Outgoing:
+    client: str
+    call: object
+    client_nonce: int
+    expired: bool = False
+    # sender -> (tag, value, signature). The reference accumulates a set of
+    # reply *tuples* (`OutgoingRequestState.scala:14`), which counts
+    # duplicate replies from one replica as distinct quorum votes (JVM
+    # byte-array identity equality) — a replay could forge a quorum. We key
+    # by sender, like its write quorum already does.
+    read_quorum: dict = field(default_factory=dict)
+    write_quorum: set = field(default_factory=set)
+    set_to_read: object = None
+    set_to_write: object = None
+
+
+class BFTABDNode:
+    """One replica endpoint. `addr` must appear in `replicas`."""
+
+    def __init__(
+        self,
+        addr: str,
+        replicas: list[str],
+        supervisor: str,
+        net: Transport,
+        config: ReplicaConfig | None = None,
+    ):
+        self.addr = addr
+        self.name = addr.rsplit("/", 1)[-1]
+        self.all_replicas = list(replicas)
+        self.supervisor = supervisor
+        self.net = net
+        self.cfg = config or ReplicaConfig()
+        self.behavior = "healthy"
+        self.repository: dict[str, tuple[M.ABDTag, object]] = {}
+        self.outgoing: dict[int, _Outgoing] = {}
+        self.incoming: dict[int, bool] = {}  # nonce -> expired
+        self.siblings = TrustedNodesList(replicas)
+        net.register(addr, self.handle)
+
+    # ------------------------------------------------------------------ util
+
+    def _state(self, key: str) -> tuple[M.ABDTag, object]:
+        if key not in self.repository:
+            self.repository[key] = (M.ABDTag(0, self.name), None)
+        return self.repository[key]
+
+    def _send(self, dest: str, msg) -> None:
+        self.net.send(self.addr, dest, msg)
+
+    def _suspect(self, endpoint: str) -> None:
+        self._send(self.supervisor, M.Suspect(endpoint, sigs.generate_nonce()))
+
+    def _debug(self, text: str) -> None:
+        if self.cfg.debug:
+            log.info("%s: %s", self.name, text)
+
+    def _broadcast(self, msg) -> None:
+        for sibling in self.siblings.get_trusted():
+            self._send(sibling, msg)
+
+    # ------------------------------------------------------------- dispatch
+
+    async def handle(self, sender: str, msg) -> None:
+        if self.behavior == "healthy":
+            await self._healthy(sender, msg)
+        elif self.behavior == "sentinent":
+            await self._sentinent(sender, msg)
+        else:
+            await self._byzantine(sender, msg)
+
+    # -------------------------------------------------------------- healthy
+
+    async def _healthy(self, sender: str, msg) -> None:
+        cfg = self.cfg
+        match msg:
+            case M.Envelope(call, nonce, signature):
+                if nonce in self.outgoing:
+                    self._debug("invalid nonce from proxy - repeated")
+                    return
+                req = _Outgoing(sender, call, nonce)
+                match call:
+                    case M.IRead(key):
+                        if not sigs.validate_proxy_signature(
+                            cfg.proxy_mac_secret, key, nonce, signature
+                        ):
+                            self._debug("invalid proxy signature")
+                        else:
+                            self._broadcast(M.Read(key, nonce))
+                    case M.IWrite(key, value):
+                        if not sigs.validate_proxy_signature(
+                            cfg.proxy_mac_secret, key, nonce, signature, value
+                        ):
+                            self._debug("invalid proxy signature")
+                        else:
+                            req.set_to_write = value
+                            self._broadcast(M.ReadTag(key, nonce))
+                    case _:
+                        log.error("unexpected API call from proxy: %r", call)
+                self.outgoing[nonce] = req
+
+            case M.ReadTag(key, nonce):
+                if nonce in self.incoming:
+                    self._debug("invalid nonce - repeated")
+                    self._suspect(sender)
+                    return
+                self.incoming[nonce] = False
+                tag, contents = self._state(key)
+                sig = sigs.abd_signature(cfg.abd_mac_secret, contents, tag, nonce)
+                self._send(sender, M.TagReply(tag, key, contents, sig, nonce))
+
+            case M.TagReply(tag, key, value, signature, nonce):
+                if not sigs.validate_abd_signature(
+                    cfg.abd_mac_secret, value, tag, nonce, signature
+                ):
+                    self._debug("invalid ABD signature")
+                    self._suspect(sender)
+                    return
+                req = self.outgoing.get(nonce)
+                if req is None:
+                    self._debug("invalid nonce - unknown")
+                    self._suspect(sender)
+                    return
+                if req.expired:
+                    self._debug("invalid nonce - expired (late quorum reply)")
+                    return
+                req.read_quorum[sender] = (tag, value, signature)
+                if len(req.read_quorum) >= cfg.quorum_size:
+                    max_tag = max(t for t, _, _ in req.read_quorum.values())
+                    req.read_quorum = {}
+                    new_tag = M.ABDTag(max_tag.seq + 1, self.name)
+                    sig = sigs.abd_signature(
+                        cfg.abd_mac_secret, req.set_to_write, new_tag, nonce
+                    )
+                    self._broadcast(M.Write(new_tag, key, req.set_to_write, sig, nonce))
+
+            case M.Write(tag, key, value, signature, nonce):
+                if not sigs.validate_abd_signature(
+                    cfg.abd_mac_secret, value, tag, nonce, signature
+                ):
+                    self._debug("invalid ABD signature")
+                    self._suspect(sender)
+                    return
+                if nonce not in self.incoming:
+                    self._debug("invalid nonce - unknown")
+                    self._suspect(sender)
+                    return
+                if self.incoming[nonce]:
+                    self._debug("invalid nonce - expired at Write (late quorum reply)")
+                    return
+                self.incoming[nonce] = True
+                cur_tag, _ = self._state(key)
+                if cur_tag < tag:
+                    self.repository[key] = (tag, value)
+                self._send(sender, M.WriteAck(key, nonce))
+
+            case M.WriteAck(key, nonce):
+                req = self.outgoing.get(nonce)
+                if req is None:
+                    self._debug("invalid nonce - unknown")
+                    self._suspect(sender)
+                    return
+                if req.expired:
+                    self._debug("invalid nonce - expired at WriteAck (late reply)")
+                    return
+                req.write_quorum.add(sender)
+                if len(req.write_quorum) >= cfg.quorum_size:
+                    req.write_quorum = set()
+                    req.expired = True
+                    challenge = req.client_nonce + cfg.nonce_increment
+                    match req.call:
+                        case M.IRead(k):
+                            sig = sigs.proxy_signature(
+                                cfg.proxy_mac_secret, k, challenge, req.set_to_read
+                            )
+                            self._send(
+                                req.client,
+                                M.Envelope(M.IReadReply(k, req.set_to_read), challenge, sig),
+                            )
+                        case M.IWrite(k, _):
+                            sig = sigs.proxy_signature(cfg.proxy_mac_secret, k, challenge)
+                            self._send(
+                                req.client, M.Envelope(M.IWriteReply(k), challenge, sig)
+                            )
+
+            case M.Read(key, nonce):
+                if nonce in self.incoming:
+                    self._debug("invalid nonce - repeated")
+                    self._suspect(sender)
+                    return
+                self.incoming[nonce] = False
+                tag, contents = self._state(key)
+                sig = sigs.abd_signature(cfg.abd_mac_secret, contents, tag, nonce)
+                self._send(sender, M.ReadReply(tag, key, contents, sig, nonce))
+
+            case M.ReadReply(tag, key, value, signature, nonce):
+                if not sigs.validate_abd_signature(
+                    cfg.abd_mac_secret, value, tag, nonce, signature
+                ):
+                    self._debug("invalid ABD signature")
+                    self._suspect(sender)
+                    return
+                req = self.outgoing.get(nonce)
+                if req is None:
+                    self._debug("invalid nonce - unknown")
+                    self._suspect(sender)
+                    return
+                if req.expired:
+                    self._debug("invalid nonce - expired at ReadReply (late reply)")
+                    return
+                req.read_quorum[sender] = (tag, value, signature)
+                if len(req.read_quorum) >= cfg.quorum_size:
+                    max_tag, max_val, max_sig = max(
+                        req.read_quorum.values(), key=lambda e: e[0]
+                    )
+                    req.read_quorum = {}
+                    req.set_to_read = max_val
+                    # ABD write-back phase, re-using the original signature
+                    self._broadcast(M.Write(max_tag, key, max_val, max_sig, nonce))
+
+            case M.Sleep(data, nonces):
+                self.repository = {
+                    k: (M.ABDTag(v["tag"][0], v["tag"][1]), v["value"])
+                    for k, v in data.items()
+                }
+                for n in nonces:
+                    self.incoming[int(n)] = True
+                self._debug("going to sleep")
+                self._send(sender, M.Complying())
+                self.behavior = "sentinent"
+
+            case M.Kill():
+                # guardian-restart semantics: fresh empty state, healthy
+                self.repository = {}
+                self.outgoing = {}
+                self.incoming = {}
+                self.behavior = "healthy"
+                self._debug("killed and restarted")
+
+            case M.Compromise():
+                self.behavior = "byzantine"
+
+            case _:
+                self._debug(f"unhandled {type(msg).__name__}")
+
+    # ------------------------------------------------------------ sentinent
+
+    async def _sentinent(self, sender: str, msg) -> None:
+        cfg = self.cfg
+        match msg:
+            case M.Write(tag, key, value, signature, nonce):
+                if not sigs.validate_abd_signature(
+                    cfg.abd_mac_secret, value, tag, nonce, signature
+                ):
+                    self._debug("invalid ABD signature (sentinent)")
+                    return
+                if nonce in self.incoming:
+                    self._debug("invalid nonce - repeated (sentinent)")
+                    return
+                self.incoming[nonce] = True
+                cur_tag, _ = self._state(key)
+                if cur_tag < tag:
+                    self.repository[key] = (tag, value)
+
+            case M.Awake():
+                self._debug("waking up")
+                data = {
+                    k: {"tag": [t.seq, t.id], "value": v}
+                    for k, (t, v) in self.repository.items()
+                }
+                self._send(sender, M.State(data, list(self.incoming.keys())))
+                self.behavior = "healthy"
+
+            case M.Kill():
+                self.repository = {}
+                self.outgoing = {}
+                self.incoming = {}
+                self.behavior = "healthy"
+
+    # ------------------------------------------------------------ byzantine
+
+    async def _byzantine(self, sender: str, msg) -> None:
+        """Simulated compromise, mirroring `BFTABDNode.scala:420-469`:
+        garbage replies, replays, forged writes, omissions — and note the
+        attacker DOES hold the real MAC key (kept per the reference threat
+        model, SURVEY.md §7)."""
+        cfg = self.cfg
+        match msg:
+            case M.Envelope(_, _, _):
+                # protocol violation: bare reply, not an Envelope
+                self._send(sender, M.IReadReply("2eikd094akldslcnu94342", None))
+
+            case M.ReadTag(key, nonce):
+                garbage = [1, "i am ", "trudy", None]
+                for _ in range(4):  # replay x4 with empty signature
+                    self._send(
+                        sender,
+                        M.TagReply(M.ABDTag(0, self.name), key, garbage, b"", nonce),
+                    )
+
+            case M.TagReply(_, key, _, _, nonce) | M.ReadReply(_, key, _, _, nonce):
+                # forge a write to every replica under a random tag
+                tag = M.ABDTag(random.getrandbits(31), sender.rsplit("/", 1)[-1])
+                sig = sigs.abd_signature(cfg.abd_mac_secret, None, tag, nonce + 1)
+                for replica in self.all_replicas:
+                    self._send(replica, M.Write(tag, key, None, sig, nonce + 1))
+
+            case M.Write(_, key, _, _, nonce):
+                self._send(sender, M.WriteAck(key, nonce))
+
+            case M.WriteAck(_, _):
+                pass  # omission
+
+            case M.Read(key, nonce):
+                tag = M.ABDTag(random.getrandbits(31), sender.rsplit("/", 1)[-1])
+                self._send(
+                    sender,
+                    M.ReadReply(tag, key, [",test,", 31, True], b"10010100110010", nonce),
+                )
+
+            case M.Kill():
+                self.repository = {}
+                self.outgoing = {}
+                self.incoming = {}
+                self.behavior = "healthy"
+
+    # ---------------------------------------------------------------- admin
+
+    def export_state(self) -> dict:
+        return {
+            k: {"tag": [t.seq, t.id], "value": v} for k, (t, v) in self.repository.items()
+        }

@@ -1,0 +1,205 @@
+"""Membership + failure manager: suspicion quorums, spares, proactive recovery.
+
+Counterpart of `dds/core/BFTSupervisor.scala`: tracks active/sentinent
+replica lists, dedupes `Suspect` votes by nonce, recovers a replica once a
+quorum of distinct voters suspects it, proactively recovers the oldest
+active replica on a timer, and serves proxies the freshest half of the
+active list.
+
+Recovery (BFTSupervisor.scala:97-153): wake a random sentinent spare
+(`Awake` -> `State{data, nonces}`), promote it to active; `Kill` the
+offender (guardian-restart semantics) and re-seed it with the spare's state
+via `Sleep` -> `Complying`, demoting it to sentinent. If the offender's
+host is dead (ask timeout), redeploy a fresh replica at the same endpoint
+through the injected factory and seed that instead.
+
+Deviations (documented): suspicion voters are the *senders* of Suspect
+votes (the reference seeds the voter set with the suspected node itself,
+`BFTSupervisor.scala:79` — a bookkeeping bug); `RequestReplicas` returns at
+least one endpoint even with a single active replica.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional
+
+from dds_tpu.core import messages as M
+from dds_tpu.core.transport import Transport
+
+log = logging.getLogger("dds.supervisor")
+
+
+@dataclass
+class SupervisorConfig:
+    quorum_size: int = 5
+    proactive_recovery_warmup: float = 5.0
+    proactive_recovery_interval: float = 7.0
+    sentinent_awake_timeout: float = 5.0
+    crashed_recovery_timeout: float = 12.0
+    proactive_recovery_enabled: bool = True
+    debug: bool = False
+
+
+class BFTSupervisor:
+    def __init__(
+        self,
+        addr: str,
+        active: list[str],
+        sentinent: list[str],
+        net: Transport,
+        config: SupervisorConfig | None = None,
+        redeploy: Optional[Callable[[str], Awaitable[None]]] = None,
+        rng: random.Random | None = None,
+    ):
+        self.addr = addr
+        self.net = net
+        self.cfg = config or SupervisorConfig()
+        self.active: list[tuple[str, int]] = [(a, time.monotonic_ns()) for a in active]
+        self.sentinent: list[str] = list(sentinent)
+        self.nonces: set[int] = set()
+        self.quorum: dict[str, set[str]] = {}
+        self.redeploy = redeploy
+        self._rng = rng or random.Random()
+        self._pending: dict[str, asyncio.Future] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._recovering: set[str] = set()  # endpoints with recovery in flight
+        net.register(addr, self.handle)
+
+    # ----------------------------------------------------------- life cycle
+
+    def start(self) -> None:
+        if self.cfg.proactive_recovery_enabled and self._task is None:
+            self._task = asyncio.ensure_future(self._proactive_loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _proactive_loop(self) -> None:
+        await asyncio.sleep(self.cfg.proactive_recovery_warmup)
+        while True:
+            if self.active:
+                oldest, _ = min(self.active, key=lambda r: r[1])
+                if self.cfg.debug:
+                    log.info("proactively recovering %s", oldest)
+                await self.recover(oldest)
+            await asyncio.sleep(self.cfg.proactive_recovery_interval)
+
+    # ------------------------------------------------------------- messages
+
+    async def handle(self, sender: str, msg) -> None:
+        match msg:
+            case M.RequestReplicas():
+                # freshest half of the active list, minimum one
+                by_age = sorted(self.active, key=lambda r: r[1], reverse=True)
+                take = max(1, len(by_age) // 2)
+                self.net.send(
+                    self.addr, sender, M.ActiveReplicas([a for a, _ in by_age[:take]])
+                )
+
+            case M.Suspect(replica, nonce):
+                if nonce in self.nonces:
+                    return
+                self.nonces.add(nonce)
+                voters = self.quorum.setdefault(replica, set())
+                voters.add(sender)
+                if len(voters) >= self.cfg.quorum_size:
+                    if self.cfg.debug:
+                        log.info("replica %s suspected faulty; recovering", replica)
+                    # clear the vote tally NOW so votes landing while the
+                    # recovery awaits don't re-trigger it
+                    self.quorum[replica] = set()
+                    await self.recover(replica)
+
+            case M.State(_, _) | M.Complying():
+                fut = self._pending.pop(f"{type(msg).__name__}:{sender}", None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+
+    async def _ask(self, dest: str, msg, reply_type: str, timeout: float):
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[f"{reply_type}:{dest}"] = fut
+        self.net.send(self.addr, dest, msg)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(f"{reply_type}:{dest}", None)
+
+    # ------------------------------------------------------------- recovery
+
+    async def recover(self, byzantine: str) -> None:
+        """Swap the suspect with a sentinent spare; reseed or redeploy it.
+
+        Guards (beyond the reference): only ACTIVE replicas are recoverable —
+        a suspicion quorum naming an arbitrary endpoint (e.g. a proxy) must
+        not consume a spare or redeploy over a non-replica address — and a
+        recovery already in flight for the same endpoint (or using the last
+        spare) is not re-entered by concurrent votes / the proactive timer.
+        """
+        if byzantine in self._recovering:
+            return
+        if byzantine not in (a for a, _ in self.active):
+            log.warning("refusing to recover non-active endpoint %s", byzantine)
+            return
+        spares = [s for s in self.sentinent if s not in self._recovering]
+        if not spares:
+            return
+        spare = self._rng.choice(spares)
+        self._recovering.update((byzantine, spare))
+        try:
+            try:
+                state = await self._ask(
+                    spare, M.Awake(), "State", self.cfg.sentinent_awake_timeout
+                )
+            except asyncio.TimeoutError:
+                log.warning("sentinent %s did not wake up", spare)
+                return
+
+            # promote the spare
+            self.sentinent.remove(spare)
+            self.active.append((spare, time.monotonic_ns()))
+
+            # kill (-> guardian restart) and demote the offender
+            self.net.send(self.addr, byzantine, M.Kill())
+            self.active = [r for r in self.active if r[0] != byzantine]
+
+            try:
+                await self._ask(
+                    byzantine,
+                    M.Sleep(state.data, state.nonces),
+                    "Complying",
+                    self.cfg.sentinent_awake_timeout,
+                )
+                self.sentinent.append(byzantine)
+                self.quorum[byzantine] = set()
+            except asyncio.TimeoutError:
+                # host is dead: redeploy a fresh replica at the same endpoint
+                if self.redeploy is None:
+                    log.warning("replica %s dead and no redeploy hook", byzantine)
+                    return
+                if self.cfg.debug:
+                    log.info("replica %s crashed; rebooting", byzantine)
+                await self.redeploy(byzantine)
+                try:
+                    await self._ask(
+                        byzantine,
+                        M.Sleep(state.data, state.nonces),
+                        "Complying",
+                        self.cfg.crashed_recovery_timeout,
+                    )
+                except asyncio.TimeoutError:
+                    log.warning("rebooted replica %s never complied", byzantine)
+                self.sentinent.append(byzantine)
+                self.quorum[byzantine] = set()
+        finally:
+            self._recovering.difference_update((byzantine, spare))

@@ -1,0 +1,214 @@
+"""Async message transports for the replicated core.
+
+The reference rides Akka remoting (netty SSL TCP) for every actor-to-actor
+hop (`dds-system.conf:18-58`). The TPU-native design keeps control-plane
+messaging on the CPU in plain asyncio (quorum logic is control flow, not
+math — SURVEY.md §5.8) with two interchangeable transports:
+
+- `InMemoryNet`: zero-copy in-process delivery with per-link fault hooks
+  (drop / delay / duplicate / corrupt) — the unit/property-test fabric the
+  reference never had, and the single-process deployment fabric (the
+  reference also runs its whole 9-replica quorum in one process when the
+  topology says so, SURVEY.md §4).
+- `TcpNet`: length-prefixed frames over asyncio TCP, optional TLS — the
+  multi-host fabric.
+
+Addresses are opaque strings ("replica-3", "host:port/replica-3"). Delivery
+is fire-and-forget and unordered, like actor tell; all integrity comes from
+the HMAC layer inside the messages.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Optional
+
+from dds_tpu.core import messages as M
+
+log = logging.getLogger("dds.transport")
+
+Handler = Callable[[str, object], Awaitable[None]]
+
+
+class Transport:
+    """Interface: register local endpoints, send to any endpoint."""
+
+    def register(self, addr: str, handler: Handler) -> None:
+        raise NotImplementedError
+
+    def unregister(self, addr: str) -> None:
+        raise NotImplementedError
+
+    def send(self, src: str, dest: str, msg: object) -> None:
+        raise NotImplementedError
+
+
+class InMemoryNet(Transport):
+    def __init__(self):
+        self._handlers: dict[str, Handler] = {}
+        # test hooks: (src, dest) or dest -> async fn(msg) -> msg | None (drop)
+        self.link_filters: dict[object, Callable] = {}
+        self._tasks: set[asyncio.Task] = set()
+
+    def register(self, addr: str, handler: Handler) -> None:
+        self._handlers[addr] = handler
+
+    def unregister(self, addr: str) -> None:
+        self._handlers.pop(addr, None)
+
+    def send(self, src: str, dest: str, msg: object) -> None:
+        task = asyncio.ensure_future(self._deliver(src, dest, msg))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _deliver(self, src: str, dest: str, msg: object) -> None:
+        for key in ((src, dest), dest):
+            f = self.link_filters.get(key)
+            if f is not None:
+                msg = await f(msg)
+                if msg is None:
+                    return
+        handler = self._handlers.get(dest)
+        if handler is None:
+            log.debug("drop %s -> %s (no endpoint): %s", src, dest, type(msg).__name__)
+            return
+        try:
+            await handler(src, msg)
+        except Exception:
+            log.exception("handler error at %s for %s", dest, type(msg).__name__)
+
+    async def quiesce(self) -> None:
+        """Wait until all in-flight deliveries (and their follow-ups) drain."""
+        while True:
+            pending = [t for t in self._tasks if not t.done()]
+            if not pending:
+                break
+            await asyncio.gather(*pending, return_exceptions=True)
+            await asyncio.sleep(0)  # let done-callbacks prune the task set
+
+
+class TcpNet(Transport):
+    """Multi-host transport: frames are 4-byte big-endian length + JSON.
+
+    Each frame carries (src, dest, payload) and, when `frame_secret` is set,
+    an HMAC-SHA256 over the canonical frame — the channel-authentication
+    role the reference delegates to mutual-TLS Akka remoting
+    (`dds-system.conf:18-58`). Without it, a keyless network attacker could
+    spoof the `src` field and forge sender-keyed quorum votes (WriteAck,
+    Suspect). TLS contexts can be layered on top/instead.
+
+    One listening socket per host serves all endpoints registered on it;
+    outbound connections are cached per destination host.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        ssl_server=None,
+        ssl_client=None,
+        frame_secret: bytes | None = None,
+    ):
+        self.host, self.port = host, port
+        self._handlers: dict[str, Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: dict[str, asyncio.StreamWriter] = {}
+        self._ssl_server, self._ssl_client = ssl_server, ssl_client
+        self._frame_secret = frame_secret
+        self._lock = asyncio.Lock()
+
+    def _frame_mac(self, src: str, dest: str, payload: dict) -> str:
+        import hashlib
+        import hmac as hmac_mod
+        import json
+
+        body = json.dumps([src, dest, payload], sort_keys=True).encode()
+        return hmac_mod.new(self._frame_secret, body, hashlib.sha256).hexdigest()
+
+    # endpoint addresses look like "host:port/name"
+    @staticmethod
+    def split(addr: str) -> tuple[str, int, str]:
+        hostport, name = addr.split("/", 1)
+        host, port = hostport.rsplit(":", 1)
+        return host, int(port), name
+
+    def local_addr(self, name: str) -> str:
+        return f"{self.host}:{self.port}/{name}"
+
+    def register(self, addr: str, handler: Handler) -> None:
+        _, _, name = self.split(addr) if "/" in addr else (None, None, addr)
+        self._handlers[name] = handler
+
+    def unregister(self, addr: str) -> None:
+        _, _, name = self.split(addr) if "/" in addr else (None, None, addr)
+        self._handlers.pop(name, None)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port, ssl=self._ssl_server
+        )
+
+    async def stop(self) -> None:
+        # close outbound connections first: the EOF unblocks server-side
+        # _serve loops, letting wait_closed() complete
+        for w in self._conns.values():
+            w.close()
+        self._conns.clear()
+        if self._server:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                frame = await reader.readexactly(int.from_bytes(hdr, "big"))
+                import json
+
+                obj = json.loads(frame)
+                src, dest, payload = obj["src"], obj["dest"], obj["msg"]
+                if self._frame_secret is not None:
+                    import hmac as hmac_mod
+
+                    if not hmac_mod.compare_digest(
+                        obj.get("mac", ""), self._frame_mac(src, dest, payload)
+                    ):
+                        log.warning("dropping frame with bad MAC (src claims %s)", src)
+                        continue
+                name = dest.split("/", 1)[1] if "/" in dest else dest
+                handler = self._handlers.get(name)
+                if handler is not None:
+                    asyncio.ensure_future(handler(src, M.from_dict(payload)))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    def send(self, src: str, dest: str, msg: object) -> None:
+        asyncio.ensure_future(self._send(src, dest, msg))
+
+    async def _send(self, src: str, dest: str, msg: object) -> None:
+        import json
+
+        host, port, _ = self.split(dest)
+        conn_key = f"{host}:{port}"
+        try:
+            async with self._lock:
+                w = self._conns.get(conn_key)
+                if w is None or w.is_closing():
+                    _, w = await asyncio.open_connection(host, port, ssl=self._ssl_client)
+                    self._conns[conn_key] = w
+            payload = M.to_dict(msg)
+            obj = {"src": src, "dest": dest, "msg": payload}
+            if self._frame_secret is not None:
+                obj["mac"] = self._frame_mac(src, dest, payload)
+            frame = json.dumps(obj).encode()
+            w.write(len(frame).to_bytes(4, "big") + frame)
+            await w.drain()
+        except OSError:
+            log.warning("send failed %s -> %s", src, dest)
+            self._conns.pop(conn_key, None)

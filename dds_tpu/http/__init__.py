@@ -1,0 +1,3 @@
+"""Tier-3 REST proxy: encrypted query engine over the BFT-ABD core."""
+
+from dds_tpu.http.server import DDSRestServer, ProxyConfig  # noqa: F401

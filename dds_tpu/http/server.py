@@ -1,0 +1,382 @@
+"""REST proxy: the 23-route encrypted query engine.
+
+Counterpart of `dds/http/DDSRestServer.scala:153-948` — same route names,
+parameters, JSON shapes and status codes — rebuilt around two TPU-first
+ideas the reference lacks:
+
+- all ciphertext arithmetic goes through the pluggable `CryptoBackend`
+  (cpu | tpu); aggregate folds (`SumAll`, `MultAll`) become ONE batched
+  tree-reduction over (K, limbs) tensors instead of K sequential
+  BigInteger multiplies (`DDSRestServer.scala:412-430, 505-524`);
+- storage access goes through the asyncio `AbdClient` quorum functions
+  (core/quorum_client.py = `fetchSet`/`writeSet`, `:952-1050`).
+
+Like the reference, the proxy is computation-only: it sees ciphertexts and
+per-request public parameters (`nsqr`, `pubkey`), never keys.
+
+Reference quirks deliberately FIXED (SURVEY.md §7 "replicate or fix"):
+- `SumAll`/`MultAll`/`Search*` used `length-1 > position`, making the last
+  column unreachable; we use `position < length` like `Sum`/`Mult` do.
+- `SearchEntry` compared the JSON wrapper's string (`item.toString`)
+  instead of the value; we compare the value.
+- `SearchEntryAND` matched on 3 *distinct stored values*; we require each
+  of the three query values to match (a real conjunction).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dds_tpu.core.quorum_client import AbdClient
+from dds_tpu.http import json_protocol as J
+from dds_tpu.http.miniserver import HttpServer, Request, Response, http_request
+from dds_tpu.models.backend import CryptoBackend, get_backend
+from dds_tpu.utils import sigs
+from dds_tpu.utils.retry import retry
+
+log = logging.getLogger("dds.rest")
+
+
+@dataclass
+class ProxyConfig:
+    host: str = "127.0.0.1"
+    port: int = 8443
+    retry_backoff: float = 0.3
+    retry_attempts: int = 2
+    crypto_backend: str = "cpu"
+    # proxy->proxy key gossip (DDSRestServer.scala:118-136)
+    key_sync_enabled: bool = False
+    key_sync_warmup: float = 1.0
+    key_sync_interval: float = 5.0
+    peers: list[str] = field(default_factory=list)  # "host:port"
+    # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
+    replica_refresh_interval: float = 5.0
+    supervisor: Optional[str] = None
+    ssl_server_context: object = None
+    ssl_client_context: object = None
+
+
+class DDSRestServer:
+    def __init__(self, abd: AbdClient, config: ProxyConfig | None = None):
+        self.abd = abd
+        self.cfg = config or ProxyConfig()
+        self.backend: CryptoBackend = get_backend(self.cfg.crypto_backend)
+        self.stored_keys: set[str] = set()
+        self._http = HttpServer(
+            self.cfg.host, self.cfg.port, self.handle, self.cfg.ssl_server_context
+        )
+        self._tasks: list[asyncio.Task] = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        await self._http.start()
+        self.cfg.port = self._http.port  # resolve OS-assigned port 0
+        if self.cfg.key_sync_enabled and self.cfg.peers:
+            self._tasks.append(asyncio.ensure_future(self._key_sync_loop()))
+        if self.cfg.supervisor:
+            if self.abd.cfg.supervisor is None:
+                self.abd.cfg.supervisor = self.cfg.supervisor  # pin ActiveReplicas source
+            self._tasks.append(asyncio.ensure_future(self._replica_refresh_loop()))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks.clear()
+        await self._http.stop()
+
+    async def _key_sync_loop(self) -> None:
+        await asyncio.sleep(self.cfg.key_sync_warmup)
+        while True:
+            for peer in self.cfg.peers:
+                host, _, port = peer.partition(":")
+                try:
+                    import json as _json
+
+                    await http_request(
+                        host,
+                        int(port),
+                        "POST",
+                        "/_sync",
+                        _json.dumps(J.keys_result(sorted(self.stored_keys))).encode(),
+                        ssl_context=self.cfg.ssl_client_context,
+                        timeout=5.0,
+                    )
+                except OSError:
+                    log.debug("key-sync peer %s unreachable", peer)
+                except asyncio.TimeoutError:
+                    log.debug("key-sync peer %s timed out", peer)
+            await asyncio.sleep(self.cfg.key_sync_interval)
+
+    async def _replica_refresh_loop(self) -> None:
+        while True:
+            self.abd.refresh_from(self.cfg.supervisor)
+            await asyncio.sleep(self.cfg.replica_refresh_interval)
+
+    # ----------------------------------------------------------- ABD access
+
+    async def _fetch(self, key: str):
+        return await retry(
+            lambda: self.abd.fetch_set(key), self.cfg.retry_backoff, self.cfg.retry_attempts
+        )
+
+    async def _write(self, key: str, value):
+        return await retry(
+            lambda: self.abd.write_set(key, value),
+            self.cfg.retry_backoff,
+            self.cfg.retry_attempts,
+        )
+
+    async def _fetch_stored(self) -> list[tuple[str, list]]:
+        """Fetch every stored key in parallel; keep the ones that exist."""
+        keys = sorted(self.stored_keys)
+        results = await asyncio.gather(
+            *(self._fetch(k) for k in keys), return_exceptions=True
+        )
+        out = []
+        for k, r in zip(keys, results):
+            if isinstance(r, Exception):
+                raise r
+            if r is not None:
+                out.append((k, r))
+        return out
+
+    # -------------------------------------------------------------- routing
+
+    async def handle(self, req: Request) -> Response:
+        try:
+            return await self._route(req)
+        except (ValueError, KeyError, TypeError) as e:
+            return Response.text(f"bad request: {e}", 400)
+        except Exception:
+            log.exception("route failure %s %s", req.method, req.path)
+            return Response(500)
+
+    async def _route(self, req: Request) -> Response:
+        parts = [p for p in req.path.split("/") if p]
+        if not parts:
+            return Response(404)
+        name, arg = parts[0], (parts[1] if len(parts) > 1 else None)
+        m = req.method
+
+        match (m, name):
+            case ("GET", "GetSet") if arg:
+                value = await self._fetch(arg)
+                if value is None:
+                    return Response(404)
+                return Response.json(J.dds_set(value))
+
+            case ("POST", "PutSet"):
+                body = req.json()
+                if body is None:
+                    key, value = sigs.random_key(), None
+                else:
+                    value = J.parse_set(body)
+                    key = sigs.key_from_set(value)
+                await self._write(key, value)
+                self.stored_keys.add(key)
+                return Response.text(key)
+
+            case ("DELETE", "RemoveSet") if arg:
+                await self._write(arg, None)
+                self.stored_keys.discard(arg)  # stop aggregating/gossiping it
+                return Response(200)
+
+            case ("PUT", "AddElement") if arg:
+                item = J.parse_item(req.json())
+                value = await self._fetch(arg)
+                if value is None:
+                    return Response(404)
+                await self._write(arg, value + [item])
+                return Response(200)
+
+            case ("GET", "ReadElement") if arg:
+                pos = self._pos(req)
+                value = await self._fetch(arg)
+                if value is None or pos > len(value) - 1:
+                    return Response(404)
+                return Response.json({"value": value[pos]})
+
+            case ("PUT", "WriteElement") if arg:
+                pos = self._pos(req)
+                item = J.parse_item(req.json())
+                value = await self._fetch(arg)
+                if value is None:
+                    return Response(404)
+                new = list(value)
+                if pos > len(new) - 1:
+                    new.append(item)
+                else:
+                    new[pos] = item
+                await self._write(arg, new)
+                return Response(200)
+
+            case ("POST", "IsElement") if arg:
+                item = J.parse_item(req.json())
+                value = await self._fetch(arg)
+                if value is None:
+                    return Response(404)
+                # deterministic-HE compare degenerates to ciphertext equality
+                found = any(str(elem) == str(item) for elem in value)
+                return Response.json(J.value_result(found))
+
+            # ---------------- ciphertext-compute aggregates ----------------
+
+            case ("GET", "Sum"):
+                return await self._pair_aggregate(req, "nsqr")
+
+            case ("GET", "SumAll"):
+                return await self._fold_aggregate(req, "nsqr")
+
+            case ("GET", "Mult"):
+                return await self._pair_aggregate(req, "pubkey")
+
+            case ("GET", "MultAll"):
+                return await self._fold_aggregate(req, "pubkey")
+
+            case ("GET", "OrderLS") | ("GET", "OrderSL"):
+                pos = self._pos(req)
+                pairs = await self._fetch_stored()
+
+                def sort_key(pair):
+                    _, value = pair
+                    if pos >= len(value):
+                        return float("-inf")
+                    return int(value[pos])
+
+                ordered = sorted(pairs, key=sort_key, reverse=(name == "OrderLS"))
+                return Response.json(J.keys_result([k for k, _ in ordered]))
+
+            case ("POST", "SearchEq") | ("POST", "SearchNEq"):
+                pos = self._pos(req)
+                item = str(J.parse_item(req.json()))
+                pairs = await self._fetch_stored()
+                want_eq = name == "SearchEq"
+                keyset = [
+                    k
+                    for k, v in pairs
+                    if pos < len(v) and (str(v[pos]) == item) == want_eq
+                ]
+                return Response.json(J.keys_result(keyset))
+
+            case ("POST", "SearchGt") | ("POST", "SearchGtEq") | (
+                "POST",
+                "SearchLt",
+            ) | ("POST", "SearchLtEq"):
+                pos = self._pos(req)
+                item = int(J.parse_item(req.json()))
+                pairs = await self._fetch_stored()
+                op = {
+                    "SearchGt": lambda e: e > item,
+                    "SearchGtEq": lambda e: e >= item,
+                    "SearchLt": lambda e: e < item,
+                    "SearchLtEq": lambda e: e <= item,
+                }[name]
+                keyset = [
+                    k for k, v in pairs if pos < len(v) and op(int(v[pos]))
+                ]
+                return Response.json(J.keys_result(keyset))
+
+            case ("POST", "SearchEntry"):
+                item = str(J.parse_item(req.json()))
+                pairs = await self._fetch_stored()
+                keyset = [
+                    k for k, v in pairs if any(str(e) == item for e in v)
+                ]
+                return Response.json(J.keys_result(keyset))
+
+            case ("POST", "SearchEntryOR"):
+                vals = [str(x) for x in J.parse_triplet(req.json())]
+                pairs = await self._fetch_stored()
+                keyset = [
+                    k
+                    for k, v in pairs
+                    if any(str(e) in vals for e in v)
+                ]
+                return Response.json(J.keys_result(keyset))
+
+            case ("POST", "SearchEntryAND"):
+                vals = [str(x) for x in J.parse_triplet(req.json())]
+                pairs = await self._fetch_stored()
+                keyset = [
+                    k
+                    for k, v in pairs
+                    if all(any(str(e) == q for e in v) for q in vals)
+                ]
+                return Response.json(J.keys_result(keyset))
+
+            case ("POST", "_sync"):
+                self.stored_keys.update(J.parse_keys(req.json()))
+                return Response(204)
+
+        return Response(404)
+
+    # ----------------------------------------------------- aggregate helpers
+
+    async def _pair_aggregate(self, req: Request, modparam: str) -> Response:
+        """`Sum` / `Mult`: combine one position of two records."""
+        key1, key2 = req.query["key1"], req.query["key2"]
+        pos = self._pos(req)
+        mod = req.query.get(modparam)
+        set1, set2 = await asyncio.gather(self._fetch(key1), self._fetch(key2))
+        if set1 is None or set2 is None:
+            return Response(404)
+        if len(set1) - 1 < pos or len(set2) - 1 < pos:
+            return Response(404)
+        c1, c2 = int(set1[pos]), int(set2[pos])
+        if mod:
+            result = self.backend.modmul(c1, c2, self._parse_modulus(mod, modparam))
+        else:
+            result = c1 + c2 if modparam == "nsqr" else c1 * c2
+        return Response.json(J.value_result(str(result)))
+
+    async def _fold_aggregate(self, req: Request, modparam: str) -> Response:
+        """`SumAll` / `MultAll`: fold one position across ALL stored records.
+
+        This is the north-star workload (SURVEY.md §3.4): on the tpu
+        backend the fold is one batched Montgomery tree-reduction.
+        """
+        pos = self._pos(req)
+        mod = req.query.get(modparam)
+        pairs = await self._fetch_stored()
+        operands = [int(v[pos]) for _, v in pairs if pos < len(v)]
+        if not operands:
+            return Response(404)
+        if mod:
+            result = self.backend.modmul_fold(
+                operands, self._parse_modulus(mod, modparam)
+            )
+        elif modparam == "nsqr":
+            result = sum(operands)
+        else:
+            result = 1
+            for o in operands:
+                result *= o
+        return Response.json(J.value_result(str(result)))
+
+    @staticmethod
+    def _pos(req: Request) -> int:
+        """Parse the `position` query param; negative values are rejected
+        (python negative indexing must not leak ciphertext columns)."""
+        pos = int(req.query["position"])
+        if pos < 0:
+            raise ValueError("position must be >= 0")
+        return pos
+
+    @staticmethod
+    def _parse_modulus(mod: str, modparam: str) -> int:
+        """`nsqr` arrives as decimal n^2; `pubkey` as decimal RSA modulus n.
+
+        (The reference ships an X509-encoded RSA key blob for `pubkey`
+        (`DDSRestServer.scala:474-477`); our wire format is the bare modulus
+        — same information, no Java key serialization.)
+        """
+        return int(mod)

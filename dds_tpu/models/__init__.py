@@ -1,0 +1,23 @@
+"""Tier-1 homomorphic / property-preserving encryption schemes.
+
+Same six scheme tags as the reference's closed-source crypto jar
+(`utils/SJHomoLibProvider.scala:22-27`, `lib/README.txt`), re-implemented
+from scratch:
+
+| tag  | scheme                         | module        |
+|------|--------------------------------|---------------|
+| PSSE | Paillier (additive HE)         | paillier.py   |
+| MSE  | RSA multiplicative HE          | mult.py       |
+| OPE  | order-preserving encryption    | ope.py        |
+| CHE  | deterministic (comparable)     | det.py        |
+| LSE  | word-searchable encryption     | searchable.py |
+| None | probabilistic AES              | rand.py       |
+
+The modular arithmetic behind PSSE/MSE runs on the tier-0 batched Montgomery
+kernels when the `tpu` backend is selected (see backend.py); all schemes also
+have a pure-CPU path used by clients and as the benchmark baseline.
+"""
+
+from dds_tpu.models.keys import HEKeys  # noqa: F401
+from dds_tpu.models.facade import HomoProvider, SCHEME_TAGS  # noqa: F401
+from dds_tpu.models.backend import get_backend  # noqa: F401

@@ -1,0 +1,33 @@
+"""Shared symmetric-crypto primitives for the string schemes.
+
+Single home for AES-256-CTR and base64 helpers used by det.py / rand.py /
+searchable.py / keys.py — one implementation to audit and evolve.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+
+def aes_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    """AES-256-CTR keystream application (encrypt == decrypt)."""
+    c = Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
+    return c.update(data) + c.finalize()
+
+
+def b64e(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def b64d(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def b64e_url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+def b64d_url(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))

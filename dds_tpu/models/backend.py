@@ -1,0 +1,87 @@
+"""Pluggable ciphertext-arithmetic backends: `cpu` (python ints) and `tpu`.
+
+This is the `crypto.backend` switch from BASELINE.json: the query engine
+(proxy) performs all its ciphertext math through this interface, using only
+*public* parameters (Paillier n^2, RSA modulus) — never private keys,
+matching the reference trust model where `HomoAdd.sum`/`HomoMult.multiply`
+run proxy-side on ciphertexts (`dds/http/DDSRestServer.scala:385,423,479`).
+
+The TPU backend converts ciphertext batches to (B, L) limb arrays and runs
+the tier-0 Montgomery kernels; a K-term aggregate costs ~1 batched modmul
+per term (tree reduction + one domain fixup). The CPU backend is the
+baseline the bench compares against.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+import numpy as np
+
+from dds_tpu.ops import bignum as bn
+from dds_tpu.ops.montgomery import ModCtx
+
+
+class CryptoBackend(Protocol):
+    """Ciphertext-domain modular arithmetic over public parameters."""
+
+    name: str
+
+    def modmul(self, c1: int, c2: int, modulus: int) -> int: ...
+
+    def modmul_fold(self, cs: list[int], modulus: int) -> int: ...
+
+    def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]: ...
+
+
+class CpuBackend:
+    """Python-int reference backend (the CPU baseline of BASELINE.md)."""
+
+    name = "cpu"
+
+    def modmul(self, c1: int, c2: int, modulus: int) -> int:
+        return c1 * c2 % modulus
+
+    def modmul_fold(self, cs: list[int], modulus: int) -> int:
+        acc = 1
+        for c in cs:
+            acc = acc * c % modulus
+        return acc
+
+    def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
+        return [pow(b, exp, modulus) for b in bases]
+
+
+class TpuBackend:
+    """Batched limb-tensor backend on the tier-0 Montgomery kernels.
+
+    Works on whatever JAX's default platform is (the real TPU chip in
+    deployment; XLA-CPU in tests). Compiled kernels are cached per modulus
+    via ModCtx.make's lru_cache.
+    """
+
+    name = "tpu"
+
+    def modmul(self, c1: int, c2: int, modulus: int) -> int:
+        return self.modmul_fold([c1, c2], modulus)
+
+    def modmul_fold(self, cs: list[int], modulus: int) -> int:
+        ctx = ModCtx.make(modulus)
+        batch = bn.ints_to_batch(cs, ctx.L)
+        out = ctx.reduce_mul(batch)
+        return bn.limbs_to_int(np.asarray(out)[0])
+
+    def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
+        ctx = ModCtx.make(modulus)
+        batch = bn.ints_to_batch(bases, ctx.L)
+        return bn.batch_to_ints(np.asarray(ctx.pow_mod(batch, exp)))
+
+
+_BACKENDS = {"cpu": CpuBackend, "tpu": TpuBackend}
+
+
+def get_backend(name: str) -> CryptoBackend:
+    try:
+        return _BACKENDS[name]()
+    except KeyError:
+        raise ValueError(f"unknown crypto backend {name!r} (have {sorted(_BACKENDS)})")

@@ -1,0 +1,78 @@
+"""Scheme facade: per-column encrypt/decrypt by scheme tag.
+
+The client-side analogue of the reference's `SJHomoLibProvider` trait
+(`utils/SJHomoLibProvider.scala:53-101`): dispatch on the six scheme tags,
+plus whole-row encrypt/decrypt against a column-schema list. Fixes the
+reference's `until to plainSet.length` off-by-one in encryptFully/
+decryptFully (SURVEY.md §7 quirks list) — the variable part here is
+`row[until:]`, nothing past the end.
+
+Ciphertext wire types (JSON-safe):
+  OPE -> int, PSSE/MSE -> decimal string, CHE/LSE/None -> base64 string.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dds_tpu.models.keys import HEKeys
+
+SCHEME_TAGS = ("OPE", "LSE", "CHE", "PSSE", "MSE", "None")
+
+# Canonical 8-column schema documented at clt/DDSDataGenerator.scala:11-23
+# and configured in client.conf:50-61.
+DEFAULT_SCHEMA = ["OPE", "CHE", "PSSE", "MSE", "CHE", "CHE", "CHE", "None"]
+
+
+@dataclass(frozen=True)
+class HomoProvider:
+    keys: HEKeys
+
+    @staticmethod
+    def generate(paillier_bits: int = 2048, rsa_bits: int = 1024) -> "HomoProvider":
+        return HomoProvider(HEKeys.generate(paillier_bits, rsa_bits))
+
+    def encrypt(self, value, tag: str):
+        k = self.keys
+        match tag:
+            case "OPE":
+                return k.ope.encrypt(int(value))
+            case "LSE":
+                return k.lse.encrypt(str(value))
+            case "CHE":
+                return k.che.encrypt(str(value))
+            case "PSSE":
+                return str(k.psse.public.encrypt(int(value)))
+            case "MSE":
+                return str(k.mse.public.encrypt(int(value)))
+            case "None":
+                return k.none.encrypt(str(value))
+        raise ValueError(f"unknown scheme tag {tag!r}")
+
+    def decrypt(self, value, tag: str):
+        k = self.keys
+        match tag:
+            case "OPE":
+                return k.ope.decrypt(int(value))
+            case "LSE":
+                return k.lse.decrypt(str(value))
+            case "CHE":
+                return k.che.decrypt(str(value))
+            case "PSSE":
+                return k.psse.decrypt_signed(int(value))
+            case "MSE":
+                return k.mse.decrypt(int(value))
+            case "None":
+                return k.none.decrypt(str(value))
+        raise ValueError(f"unknown scheme tag {tag!r}")
+
+    def encrypt_row(self, row: list, until: int, schema: list[str]) -> list:
+        """Encrypt row[:until] per-column by schema, the rest with "None"."""
+        fixed = [self.encrypt(v, schema[i]) for i, v in enumerate(row[:until])]
+        variable = [self.encrypt(v, "None") for v in row[until:]]
+        return fixed + variable
+
+    def decrypt_row(self, row: list, until: int, schema: list[str]) -> list:
+        fixed = [self.decrypt(v, schema[i]) for i, v in enumerate(row[:until])]
+        variable = [self.decrypt(v, "None") for v in row[until:]]
+        return fixed + variable

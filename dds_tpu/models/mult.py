@@ -1,0 +1,67 @@
+"""RSA multiplicatively homomorphic encryption (scheme tag "MSE").
+
+Mirrors the behavior the reference consumes from `hlib.hj.mlib.HomoMult`
+(`utils/SJHomoLibProvider.scala:59,69`; proxy-side product at
+`dds/http/DDSRestServer.scala:479,518`): textbook RSA, where
+
+    enc(m) = m^e mod n,  dec(c) = c^d mod n,  mult = c1 * c2 mod n
+
+so dec(mult(c1, c2)) = m1 * m2 mod n. Deterministic, malleable — that is
+the point: the proxy multiplies ciphertexts it cannot read.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.asymmetric import rsa
+
+
+@dataclass(frozen=True)
+class RsaMultPublicKey:
+    n: int
+    e: int = 65537
+
+    def encrypt(self, m: int) -> int:
+        return pow(m % self.n, self.e, self.n)
+
+    def mult(self, c1: int, c2: int) -> int:
+        return c1 * c2 % self.n
+
+
+@dataclass(frozen=True)
+class RsaMultKey:
+    n: int
+    e: int
+    d: int
+    p: int
+    q: int
+
+    @property
+    def public(self) -> RsaMultPublicKey:
+        return RsaMultPublicKey(self.n, self.e)
+
+    @staticmethod
+    def generate(bits: int = 1024) -> "RsaMultKey":
+        # Reference ships an RSA-1024 multiplicative key (client.conf:86).
+        if bits >= 1024:
+            priv = rsa.generate_private_key(public_exponent=65537, key_size=bits)
+            nums = priv.private_numbers()
+            pub = nums.public_numbers
+            return RsaMultKey(n=pub.n, e=pub.e, d=nums.d, p=nums.p, q=nums.q)
+        from dds_tpu.models.primes import rsa_primes
+
+        e = 65537
+        while True:
+            p, q = rsa_primes(bits)
+            phi = (p - 1) * (q - 1)
+            if phi % e:
+                return RsaMultKey(n=p * q, e=e, d=pow(e, -1, phi), p=p, q=q)
+
+    def decrypt(self, c: int) -> int:
+        # CRT decryption: two half-size modexps.
+        mp = pow(c % self.p, self.d % (self.p - 1), self.p)
+        mq = pow(c % self.q, self.d % (self.q - 1), self.q)
+        qinv = pow(self.q, -1, self.p)
+        u = (mp - mq) * qinv % self.p
+        return mq + u * self.q

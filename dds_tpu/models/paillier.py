@@ -1,0 +1,116 @@
+"""Paillier additively homomorphic encryption (scheme tag "PSSE").
+
+Re-implements the behavior the reference consumes from `hlib.hj.mlib.HomoAdd`
+/ `PaillierKey` (`utils/SJHomoLibProvider.scala:58,68`, aggregate folds at
+`dds/http/DDSRestServer.scala:385,423`): encrypt/decrypt of integers and
+ciphertext-domain addition (modular multiply mod n^2).
+
+Math (g = n + 1 throughout, so g^m = 1 + m*n mod n^2 needs no modexp):
+
+    enc(m; r) = (1 + m*n) * r^n  mod n^2      r random in Z_n*
+    dec(c)    = L(c^lambda mod n^2) * mu mod n,  L(x) = (x-1)/n
+    add       = c1 * c2 mod n^2
+    scalar    = c^k mod n^2
+
+Decryption uses the CRT split over p^2 / q^2 (two half-size modexps instead
+of one full-size), the standard Paillier speedup (cf. PAPERS.md CRT-Paillier).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from math import gcd
+
+from cryptography.hazmat.primitives.asymmetric import rsa
+
+
+def _lcm(a: int, b: int) -> int:
+    return a // gcd(a, b) * b
+
+
+@dataclass(frozen=True)
+class PaillierPublicKey:
+    n: int
+
+    @property
+    def nsquare(self) -> int:
+        return self.n * self.n
+
+    def encrypt(self, m: int, r: int | None = None) -> int:
+        n, n2 = self.n, self.nsquare
+        m = m % n
+        if r is None:
+            r = self.random_r()
+        # (1 + m n) r^n mod n^2
+        return (1 + m * n) % n2 * pow(r, n, n2) % n2
+
+    def random_r(self) -> int:
+        n = self.n
+        while True:
+            r = secrets.randbelow(n - 1) + 1
+            if gcd(r, n) == 1:
+                return r
+
+    def add(self, c1: int, c2: int) -> int:
+        return c1 * c2 % self.nsquare
+
+    def scalar_mul(self, c: int, k: int) -> int:
+        return pow(c, k, self.nsquare)
+
+
+@dataclass(frozen=True)
+class PaillierKey:
+    """Private key. p, q are the prime factors of n (equal bit length)."""
+
+    n: int
+    p: int
+    q: int
+
+    @property
+    def public(self) -> PaillierPublicKey:
+        return PaillierPublicKey(self.n)
+
+    @property
+    def nsquare(self) -> int:
+        return self.n * self.n
+
+    @staticmethod
+    def generate(bits: int = 2048) -> "PaillierKey":
+        if bits >= 1024:
+            # cryptography's RSA keygen produces two same-size primes fast;
+            # we only use p and q (it refuses sizes below 1024).
+            priv = rsa.generate_private_key(public_exponent=65537, key_size=bits)
+            nums = priv.private_numbers()
+            p, q = nums.p, nums.q
+        else:
+            from dds_tpu.models.primes import rsa_primes
+
+            p, q = rsa_primes(bits)
+        return PaillierKey(n=p * q, p=p, q=q)
+
+    # -- decryption (CRT) ---------------------------------------------------
+
+    def _crt_params(self):
+        p, q, n = self.p, self.q, self.n
+        hp = pow((pow(1 + n, p - 1, p * p) - 1) // p, -1, p)
+        hq = pow((pow(1 + n, q - 1, q * q) - 1) // q, -1, q)
+        qinv = pow(q, -1, p)
+        return hp, hq, qinv
+
+    def decrypt(self, c: int) -> int:
+        p, q, n = self.p, self.q, self.n
+        hp, hq, qinv = self._crt_params()
+        mp = (pow(c % (p * p), p - 1, p * p) - 1) // p % p * hp % p
+        mq = (pow(c % (q * q), q - 1, q * q) - 1) // q % q * hq % q
+        u = (mp - mq) * qinv % p
+        return (mq + u * q) % n
+
+    def decrypt_signed(self, c: int) -> int:
+        """Decrypt, mapping the upper half of Z_n back to negative ints."""
+        m = self.decrypt(c)
+        return m - self.n if m > self.n // 2 else m
+
+    @property
+    def lam(self) -> int:
+        return _lcm(self.p - 1, self.q - 1)

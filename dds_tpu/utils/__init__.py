@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: HMAC signatures, nonces, trust lists, retry, config."""

@@ -1,0 +1,21 @@
+"""Async retry-with-fixed-backoff, counterpart of `utils/FutureRetry.scala`."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+async def retry(f: Callable[[], Awaitable[T]], delay: float, retries: int) -> T:
+    """Run `f`; on exception wait `delay` seconds and retry up to `retries`
+    more times; the final failure propagates."""
+    for attempt in range(retries + 1):
+        try:
+            return await f()
+        except Exception:
+            if attempt == retries:
+                raise
+            await asyncio.sleep(delay)
+    raise AssertionError("unreachable")

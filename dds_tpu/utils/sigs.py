@@ -1,0 +1,71 @@
+"""Record keys, nonces and HMAC signatures.
+
+Counterpart of the reference's `utils/Utils.scala:15-57`: SHA-512 content
+hashes for record keys, SecureRandom nonces, and two HMAC families — the
+intranet (replica<->replica) "ABD" signature over (value, tag, nonce) and
+the proxy<->replica signature over (key[, value], nonce). All comparisons
+are constant-time.
+
+Deviations (flagged per SURVEY.md §7):
+- The reference's ABD signature covers `tag.seq + 1` instead of `tag.seq`
+  (`Utils.scala:33`) — harmless but weird; we sign the actual seq.
+- Values are serialized as canonical JSON, not JVM `toString`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import secrets
+
+
+def canonical(value) -> str:
+    """Deterministic serialization of a JSON-ish value for hashing/signing."""
+    return json.dumps(value, sort_keys=True, separators=(",", ":"), default=str)
+
+
+def key_from_set(contents: list) -> str:
+    """SHA-512 content-hash record key (hex, upper) — `Utils.scala:15-18`."""
+    return hashlib.sha512(canonical(contents).encode()).hexdigest().upper()
+
+
+def random_key() -> str:
+    """Random SHA-512 record key — `Utils.scala:21-26`."""
+    return hashlib.sha512(secrets.token_bytes(100)).hexdigest().upper()
+
+
+def generate_nonce() -> int:
+    return secrets.randbits(63)
+
+
+def _mac(secret: bytes, content: bytes) -> bytes:
+    return hmac.new(secret, content, hashlib.sha256).digest()
+
+
+def abd_signature(secret: bytes, value, tag, nonce: int) -> bytes:
+    """Intranet replica signature over (value, tag, nonce)."""
+    content = f"{canonical(value)}|{tag.seq}|{tag.id}|{nonce}".encode()
+    return _mac(secret, content)
+
+
+def validate_abd_signature(secret: bytes, value, tag, nonce: int, given: bytes) -> bool:
+    return hmac.compare_digest(abd_signature(secret, value, tag, nonce), given)
+
+
+_NO_VALUE = object()
+
+
+def proxy_signature(secret: bytes, key: str, nonce: int, value=_NO_VALUE) -> bytes:
+    """Proxy<->replica signature; two arities like `Utils.scala:42-49`."""
+    if value is _NO_VALUE:
+        content = f"{key}|{nonce}".encode()
+    else:
+        content = f"{key}|{canonical(value)}|{nonce}".encode()
+    return _mac(secret, content)
+
+
+def validate_proxy_signature(secret: bytes, key: str, nonce: int, given: bytes, value=_NO_VALUE) -> bool:
+    if value is _NO_VALUE:
+        return hmac.compare_digest(proxy_signature(secret, key, nonce), given)
+    return hmac.compare_digest(proxy_signature(secret, key, nonce, value), given)

@@ -1,0 +1,39 @@
+"""Per-node suspicion strike counter with random load balancing.
+
+Counterpart of `utils/TrustedNodesList.scala`: 3 strikes exclude a node
+from the trusted set; `defer_to` picks a random trusted node.
+"""
+
+from __future__ import annotations
+
+import random
+
+STRIKE_LIMIT = 3
+
+
+class TrustedNodesList:
+    def __init__(self, nodes: list[str] | None = None, rng: random.Random | None = None):
+        self._strikes: dict[str, int] = {n: 0 for n in (nodes or [])}
+        self._rng = rng or random.Random()
+
+    def increment_suspicion(self, node: str) -> None:
+        self._strikes[node] = self._strikes.get(node, 0) + 1
+
+    def get_untrusted(self) -> list[str]:
+        return [n for n, s in self._strikes.items() if s >= STRIKE_LIMIT]
+
+    def get_trusted(self) -> list[str]:
+        return [n for n, s in self._strikes.items() if s < STRIKE_LIMIT]
+
+    def get_all(self) -> list[str]:
+        return list(self._strikes)
+
+    def reset(self, nodes: list[str]) -> None:
+        """Replace the membership, keeping strikes of surviving nodes."""
+        self._strikes = {n: self._strikes.get(n, 0) for n in nodes}
+
+    def defer_to(self) -> str:
+        trusted = self.get_trusted()
+        if not trusted:
+            raise RuntimeError("no trusted nodes left")
+        return self._rng.choice(trusted)

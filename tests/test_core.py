@@ -1,0 +1,336 @@
+"""BFT-ABD protocol tests over the in-memory transport.
+
+The property layer the reference never had (SURVEY.md §4): quorum
+read/write semantics, replay/signature rejection, Byzantine tolerance up to
+f=2 with n=7/q=5, and the supervisor's swap/recovery choreography.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from dds_tpu.core import messages as M
+from dds_tpu.core.errors import ByzantineError
+from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
+from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
+from dds_tpu.core.supervisor import BFTSupervisor, SupervisorConfig
+from dds_tpu.core.transport import InMemoryNet
+from dds_tpu.utils import sigs
+
+
+class Cluster:
+    """In-process cluster: n replicas (+spares), a supervisor, one client."""
+
+    def __init__(self, n_active=7, n_sentinent=2, quorum=5, proactive=False):
+        self.net = InMemoryNet()
+        self.rcfg = ReplicaConfig(quorum_size=quorum)
+        all_addrs = [f"replica-{i}" for i in range(n_active + n_sentinent)]
+        self.active = all_addrs[:n_active]
+        self.sentinent = all_addrs[n_active:]
+        self.replicas = {
+            a: BFTABDNode(a, all_addrs, "supervisor", self.net, self.rcfg)
+            for a in all_addrs
+        }
+        for a in self.sentinent:
+            self.replicas[a].behavior = "sentinent"
+        self.supervisor = BFTSupervisor(
+            "supervisor",
+            self.active,
+            self.sentinent,
+            self.net,
+            SupervisorConfig(
+                quorum_size=quorum,
+                proactive_recovery_enabled=proactive,
+                proactive_recovery_warmup=0.05,
+                proactive_recovery_interval=0.1,
+                sentinent_awake_timeout=0.5,
+            ),
+            redeploy=self._redeploy,
+            rng=random.Random(3),
+        )
+        self.client = AbdClient(
+            "proxy-0",
+            self.net,
+            self.active,
+            AbdClientConfig(request_timeout=1.0),
+        )
+        self.client.replicas._rng = random.Random(7)
+
+    async def _redeploy(self, endpoint):
+        self.replicas[endpoint] = BFTABDNode(
+            endpoint, list(self.replicas), "supervisor", self.net, self.rcfg
+        )
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_write_then_read_roundtrip():
+    async def go():
+        c = Cluster()
+        value = [41, "enc-blob", "123456789", None]
+        key = sigs.key_from_set(value)
+        assert await c.client.write_set(key, value) == key
+        assert await c.client.fetch_set(key) == value
+        await c.net.quiesce()
+        # at least a quorum of replicas hold the value
+        holders = [
+            r for r in c.replicas.values()
+            if r.repository.get(key, (None, None))[1] == value
+        ]
+        assert len(holders) >= 5
+
+    run(go())
+
+
+def test_read_missing_key_returns_none():
+    async def go():
+        c = Cluster()
+        assert await c.client.fetch_set("DEADBEEF") is None
+
+    run(go())
+
+
+def test_remove_via_write_none():
+    async def go():
+        c = Cluster()
+        key = "K1"
+        await c.client.write_set(key, [1, 2, 3])
+        await c.client.write_set(key, None)
+        assert await c.client.fetch_set(key) is None
+
+    run(go())
+
+
+def test_sequential_writes_last_wins():
+    async def go():
+        c = Cluster()
+        key = "K2"
+        for i in range(5):
+            await c.client.write_set(key, [i])
+        assert await c.client.fetch_set(key) == [4]
+
+    run(go())
+
+
+def test_byzantine_minority_tolerated():
+    async def go():
+        c = Cluster()
+        # compromise f=2 replicas (not the ones the seeded client rng picks)
+        victims = ["replica-5", "replica-6"]
+        for v in victims:
+            c.net.send("trudy", v, M.Compromise())
+        await c.net.quiesce()
+        c.client.replicas.reset([a for a in c.active if a not in victims])
+        value = [7, "x"]
+        key = sigs.key_from_set(value)
+        await c.client.write_set(key, value)
+        assert await c.client.fetch_set(key) == value
+
+    run(go())
+
+
+def test_byzantine_coordinator_detected():
+    async def go():
+        c = Cluster()
+        c.client.replicas.reset(["replica-0"])  # force coordinator choice
+        c.net.send("trudy", "replica-0", M.Compromise())
+        await c.net.quiesce()
+        with pytest.raises((ByzantineError, asyncio.TimeoutError)):
+            await c.client.fetch_set("ANYKEY")
+        assert c.client.replicas._strikes["replica-0"] >= 1
+
+    run(go())
+
+
+def test_replayed_proxy_nonce_ignored():
+    async def go():
+        c = Cluster()
+        key = "K3"
+        nonce = sigs.generate_nonce()
+        sig = sigs.proxy_signature(c.rcfg.proxy_mac_secret, key, nonce, [1])
+        env = M.Envelope(M.IWrite(key, [1]), nonce, sig)
+        c.net.send("proxy-0", "replica-0", env)
+        await c.net.quiesce()
+        before = c.replicas["replica-1"].repository.get(key)
+        # replay the same nonce with different contents
+        sig2 = sigs.proxy_signature(c.rcfg.proxy_mac_secret, key, nonce, [2])
+        c.net.send("proxy-0", "replica-0", M.Envelope(M.IWrite(key, [2]), nonce, sig2))
+        await c.net.quiesce()
+        after = c.replicas["replica-1"].repository.get(key)
+        assert before == after  # second write never executed
+
+    run(go())
+
+
+def test_bad_proxy_signature_rejected():
+    async def go():
+        c = Cluster()
+        nonce = sigs.generate_nonce()
+        env = M.Envelope(M.IWrite("K4", [1]), nonce, b"forged")
+        c.net.send("proxy-0", "replica-0", env)
+        await c.net.quiesce()
+        assert all("K4" not in r.repository for r in c.replicas.values())
+
+    run(go())
+
+
+def test_suspicion_quorum_triggers_recovery():
+    async def go():
+        c = Cluster()
+        # 5 distinct replicas vote against replica-6
+        for i in range(5):
+            c.net.send(
+                f"replica-{i}", "supervisor", M.Suspect("replica-6", sigs.generate_nonce())
+            )
+        await c.net.quiesce()
+        await asyncio.sleep(0.1)
+        await c.net.quiesce()
+        # replica-6 was demoted to sentinent; one spare was promoted
+        assert "replica-6" in c.supervisor.sentinent
+        active_names = [a for a, _ in c.supervisor.active]
+        assert "replica-6" not in active_names
+        assert len(active_names) == 7
+        assert c.replicas["replica-6"].behavior == "sentinent"
+
+    run(go())
+
+
+def test_recovery_preserves_data():
+    async def go():
+        c = Cluster()
+        value = [9, "persist"]
+        key = sigs.key_from_set(value)
+        await c.client.write_set(key, value)
+        await c.net.quiesce()
+        # recover replica-0 explicitly (as the proactive timer would)
+        await c.supervisor.recover("replica-0")
+        await c.net.quiesce()
+        # the promoted spare holds the data (it observed quorum writes while
+        # sentinent) and the demoted node was reseeded with it
+        assert c.replicas["replica-0"].repository.get(key, (None, None))[1] == value
+        assert await c.client.fetch_set(key) == value
+
+    run(go())
+
+
+def test_proactive_recovery_loop():
+    async def go():
+        c = Cluster(proactive=True)
+        c.supervisor.start()
+        await asyncio.sleep(0.4)
+        await c.supervisor.stop()
+        await c.net.quiesce()
+        # at least one swap happened; membership sizes preserved
+        assert len(c.supervisor.active) == 7
+        assert len(c.supervisor.sentinent) == 2
+
+    run(go())
+
+
+def test_request_replicas_returns_freshest_half():
+    async def go():
+        c = Cluster()
+        got = []
+
+        async def catcher(sender, msg):
+            got.append(msg)
+
+        c.net.register("observer", catcher)
+        c.net.send("observer", "supervisor", M.RequestReplicas())
+        await c.net.quiesce()
+        assert isinstance(got[0], M.ActiveReplicas)
+        assert len(got[0].replicas) == 3  # newest half of 7
+
+    run(go())
+
+
+def test_message_serialization_roundtrip():
+    msgs = [
+        M.Envelope(M.IWrite("K", [1, "a", None]), 42, b"\x01\x02"),
+        M.TagReply(M.ABDTag(3, "replica-1"), "K", None, b"sig", 9),
+        M.Sleep({"K": {"tag": [1, "r"], "value": [1]}}, [4, 5]),
+        M.ActiveReplicas(["a", "b"]),
+        M.Compromise(),
+    ]
+    for m in msgs:
+        assert M.loads(M.dumps(m)) == m
+
+
+def test_tcp_transport_roundtrip():
+    async def go():
+        from dds_tpu.core.transport import TcpNet
+
+        net = TcpNet("127.0.0.1", 39471)
+        await net.start()
+        got = asyncio.get_event_loop().create_future()
+
+        async def handler(sender, msg):
+            got.set_result((sender, msg))
+
+        net.register("127.0.0.1:39471/alice", handler)
+        net.send("bob", "127.0.0.1:39471/alice", M.ReadTag("K", 77))
+        sender, msg = await asyncio.wait_for(got, 3)
+        assert msg == M.ReadTag("K", 77)
+        await net.stop()
+
+    run(go())
+
+
+def test_tcp_frame_mac_rejects_spoofed_frames():
+    async def go():
+        import json as _json
+
+        from dds_tpu.core.transport import TcpNet
+
+        net = TcpNet("127.0.0.1", 0 or 39474, frame_secret=b"cluster-secret")
+        await net.start()
+        got = []
+
+        async def handler(sender, msg):
+            got.append((sender, msg))
+
+        net.register("127.0.0.1:39474/sup", handler)
+        # legitimate frame (signed by the transport itself)
+        net.send("replica-0", "127.0.0.1:39474/sup", M.ReadTag("K", 1))
+        await asyncio.sleep(0.2)
+        # forged frame: attacker with socket access but no frame secret
+        r, w = await asyncio.open_connection("127.0.0.1", 39474)
+        frame = _json.dumps(
+            {"src": "replica-1", "dest": "127.0.0.1:39474/sup",
+             "msg": M.to_dict(M.Suspect("replica-6", 99))}
+        ).encode()
+        w.write(len(frame).to_bytes(4, "big") + frame)
+        await w.drain()
+        await asyncio.sleep(0.2)
+        w.close()
+        await net.stop()
+        assert [type(m).__name__ for _, m in got] == ["ReadTag"]  # spoof dropped
+
+    run(go())
+
+
+def test_concurrent_suspects_single_recovery():
+    async def go():
+        c = Cluster()
+        # flood: every replica votes many times against replica-6
+        for round_ in range(3):
+            for i in range(7):
+                c.net.send(
+                    f"replica-{i}", "supervisor",
+                    M.Suspect("replica-6", sigs.generate_nonce()),
+                )
+        await c.net.quiesce()
+        await asyncio.sleep(0.2)
+        await c.net.quiesce()
+        # exactly one swap: sizes intact, no duplicate active entries
+        names = [a for a, _ in c.supervisor.active]
+        assert len(names) == len(set(names)) == 7
+        assert len(c.supervisor.sentinent) == 2
+        # non-active endpoints are not recoverable
+        await c.supervisor.recover("proxy-0")
+        assert len(c.supervisor.active) == 7
+
+    run(go())

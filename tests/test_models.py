@@ -1,0 +1,142 @@
+"""Tier-1 scheme tests: roundtrips, homomorphisms, backend parity.
+
+Covers what the reference exercises through `SJHomoLibProvider` plus the
+properties its proxy relies on (det compare, OPE ordering, ciphertext
+add/mult), against both crypto backends.
+"""
+
+import random
+
+import pytest
+
+from dds_tpu.models import HEKeys, HomoProvider, get_backend
+from dds_tpu.models.facade import DEFAULT_SCHEMA
+from dds_tpu.models.paillier import PaillierKey
+from dds_tpu.models.mult import RsaMultKey
+
+rng = random.Random(1)
+
+# Small keys keep CPU-mesh tests fast; key-size sweeps happen in bench.
+KEYS = HEKeys.generate(paillier_bits=512, rsa_bits=512)
+PROVIDER = HomoProvider(KEYS)
+
+
+def test_paillier_roundtrip_and_add():
+    pk = KEYS.psse.public
+    for _ in range(5):
+        a, b = rng.randrange(1 << 31), rng.randrange(1 << 31)
+        ca, cb = pk.encrypt(a), pk.encrypt(b)
+        assert ca != cb
+        assert KEYS.psse.decrypt(ca) == a
+        assert KEYS.psse.decrypt(pk.add(ca, cb)) == a + b
+        assert KEYS.psse.decrypt(pk.scalar_mul(ca, 7)) == 7 * a
+
+
+def test_paillier_negative():
+    pk = KEYS.psse.public
+    assert KEYS.psse.decrypt_signed(pk.encrypt(-42)) == -42
+    c = pk.add(pk.encrypt(-42), pk.encrypt(40))
+    assert KEYS.psse.decrypt_signed(c) == -2
+
+
+def test_rsa_mult():
+    k = KEYS.mse
+    a, b = 1234567, 89012
+    prod = k.public.mult(k.public.encrypt(a), k.public.encrypt(b))
+    assert k.decrypt(prod) == a * b
+
+
+def test_ope_order_and_roundtrip():
+    k = KEYS.ope
+    xs = sorted(rng.sample(range(-(1 << 31), 1 << 31), 50))
+    cs = [k.encrypt(x) for x in xs]
+    assert cs == sorted(cs)
+    assert [k.decrypt(c) for c in cs] == xs
+    with pytest.raises(ValueError):
+        k.encrypt(1 << 40)
+    with pytest.raises(ValueError):
+        k.decrypt(cs[0] + 1)
+
+
+def test_det_deterministic():
+    k = KEYS.che
+    c1, c2 = k.encrypt("hello"), k.encrypt("hello")
+    assert c1 == c2 and k.compare(c1, c2)
+    assert not k.compare(c1, k.encrypt("world"))
+    assert k.decrypt(c1) == "hello"
+
+
+def test_searchable():
+    k = KEYS.lse
+    c = k.encrypt("the quick brown fox")
+    assert k.decrypt(c) == "the quick brown fox"
+    assert k.matches(c, k.trapdoor("quick"))
+    assert not k.matches(c, k.trapdoor("slow"))
+
+
+def test_rand_probabilistic():
+    k = KEYS.none
+    c1, c2 = k.encrypt("same"), k.encrypt("same")
+    assert c1 != c2
+    assert k.decrypt(c1) == k.decrypt(c2) == "same"
+
+
+def test_key_serialization_roundtrip():
+    blob = KEYS.to_json()
+    back = HEKeys.from_json(blob)
+    assert back == KEYS
+    # loaded keys decrypt what original keys encrypted
+    c = KEYS.psse.public.encrypt(99)
+    assert back.psse.decrypt(c) == 99
+    assert back.che.decrypt(KEYS.che.encrypt("x")) == "x"
+
+
+def test_row_roundtrip_default_schema():
+    row = [41, "bob", 1500, 3, "eng", "lisbon", "blue", "free text tail", "more"]
+    enc = PROVIDER.encrypt_row(row, 8, DEFAULT_SCHEMA)
+    assert len(enc) == len(row)
+    assert enc[0] != row[0] and isinstance(enc[0], int)
+    dec = PROVIDER.decrypt_row(enc, 8, DEFAULT_SCHEMA)
+    assert dec == [41, "bob", 1500, 3, "eng", "lisbon", "blue", "free text tail", "more"]
+
+
+def test_unknown_scheme_tag():
+    with pytest.raises(ValueError):
+        PROVIDER.encrypt(1, "XYZ")
+
+
+@pytest.mark.parametrize("backend_name", ["cpu", "tpu"])
+def test_backend_paillier_sum(backend_name):
+    be = get_backend(backend_name)
+    pk = KEYS.psse.public
+    vals = [rng.randrange(1 << 20) for _ in range(9)]
+    cs = [pk.encrypt(v) for v in vals]
+    total = be.modmul_fold(cs, pk.nsquare)
+    assert KEYS.psse.decrypt(total) == sum(vals)
+    pair = be.modmul(cs[0], cs[1], pk.nsquare)
+    assert KEYS.psse.decrypt(pair) == vals[0] + vals[1]
+
+
+@pytest.mark.parametrize("backend_name", ["cpu", "tpu"])
+def test_backend_rsa_product(backend_name):
+    be = get_backend(backend_name)
+    k = KEYS.mse
+    vals = [rng.randrange(1 << 8) for _ in range(5)]
+    cs = [k.public.encrypt(v) for v in vals]
+    prod = be.modmul_fold(cs, k.n)
+    want = 1
+    for v in vals:
+        want *= v
+    assert k.decrypt(prod) == want
+
+
+def test_backend_powmod_parity():
+    cpu, tpu = get_backend("cpu"), get_backend("tpu")
+    n = KEYS.mse.n
+    bases = [rng.randrange(n) for _ in range(4)]
+    assert cpu.powmod_batch(bases, 65537, n) == tpu.powmod_batch(bases, 65537, n)
+
+
+def test_unknown_backend():
+    with pytest.raises(ValueError):
+        get_backend("gpu")
