@@ -1,0 +1,90 @@
+"""North-star benchmark: encrypted SUM throughput @ Paillier-2048.
+
+Measures the proxy-side homomorphic-add fold (the compute inside the
+`SumAll` route, = the reference's per-ciphertext `HomoAdd.sum` loop at
+`dds/http/DDSRestServer.scala:412-430`) on both crypto backends:
+
+- cpu:  sequential python-int modmul fold mod n^2 (the BASELINE.md CPU ref)
+- tpu:  one batched Montgomery tree-reduction over (K, 256) uint32 limbs
+
+and verifies both against Paillier decryption before timing. Emits ONE
+JSON line:  {"metric", "value", "unit", "vs_baseline"} where value is the
+TPU backend's homomorphic adds/sec and vs_baseline is the speedup over the
+CPU backend on this host.
+
+Config matches BASELINE.json's north star: Paillier-2048 (4096-bit n^2);
+the 4-replica BFT (f=1) quorum path is exercised end-to-end in
+tests/test_rest.py — this bench isolates the crypto hot loop both backends
+share so the number reflects kernel throughput, not HTTP overhead.
+"""
+
+import json
+import secrets
+import time
+
+import numpy as np
+
+
+def bench(K: int = 8192, repeats: int = 5, verify: bool = True) -> dict:
+    from dds_tpu.bench_key import bench_paillier_key
+    from dds_tpu.models.backend import CpuBackend, TpuBackend
+    from dds_tpu.ops import bignum as bn
+    from dds_tpu.ops.montgomery import ModCtx
+
+    key = bench_paillier_key()
+    pk = key.public
+    n2 = pk.nsquare
+
+    cpu = CpuBackend()
+    tpu = TpuBackend()
+
+    if verify:
+        # correctness gate on REAL ciphertexts: encrypt, fold, decrypt
+        vals = [secrets.randbelow(1 << 32) for _ in range(64)]
+        sub = [pk.encrypt(v) for v in vals]
+        tpu_fold = tpu.modmul_fold(sub, n2)
+        assert key.decrypt(tpu_fold) == sum(vals), "tpu backend SumAll decrypts wrong"
+        assert tpu_fold == cpu.modmul_fold(sub, n2)
+
+    # timing operands: uniform residues mod n^2 (statistically identical
+    # modmul cost to real ciphertexts; encrypting K of them host-side would
+    # dominate the benchmark setup)
+    cs = [secrets.randbelow(n2) for _ in range(K)]
+
+    # CPU baseline: K-1 homomorphic adds
+    t_cpu = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        cpu.modmul_fold(cs, n2)
+        t_cpu.append(time.perf_counter() - t0)
+    cpu_ops = (K - 1) / min(t_cpu)
+
+    # TPU: same fold as one batched tree reduction (includes host<->device
+    # transfer of the ciphertext batch, as the proxy would pay it)
+    ctx = ModCtx.make(n2)
+    batch = bn.ints_to_batch(cs, ctx.L)
+    np.asarray(ctx.reduce_mul(batch))  # warm/compile
+    t_tpu = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(ctx.reduce_mul(batch))
+        t_tpu.append(time.perf_counter() - t0)
+    tpu_ops = (K - 1) / min(t_tpu)
+
+    return {
+        "metric": "encrypted SUM ops/sec @ Paillier-2048 (batched homomorphic add)",
+        "value": round(tpu_ops, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(tpu_ops / cpu_ops, 3),
+        "detail": {
+            "K": K,
+            "cpu_ops_per_sec": round(cpu_ops, 1),
+            "tpu_fold_ms": round(min(t_tpu) * 1e3, 2),
+            "cpu_fold_ms": round(min(t_cpu) * 1e3, 2),
+        },
+    }
+
+
+if __name__ == "__main__":
+    result = bench()
+    print(json.dumps(result))

@@ -1,0 +1,5 @@
+"""Tier-5 client harness: instruction set, workload generator, bench client."""
+
+from dds_tpu.clt.instructions import Digest  # noqa: F401
+from dds_tpu.clt.generator import generate  # noqa: F401
+from dds_tpu.clt.client import DDSHttpClient, ClientConfig  # noqa: F401

@@ -1,0 +1,3 @@
+"""Fault injection: the built-in attacker ("Trudy")."""
+
+from dds_tpu.malicious.trudy import Trudy, AttackType, parse_attack  # noqa: F401

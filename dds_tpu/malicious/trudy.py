@@ -1,0 +1,56 @@
+"""Trudy: crash / Byzantine fault injector.
+
+Counterpart of `malicious/MaliciousAttack.scala` + `malicious/Trudy.scala`:
+the attack enum and parser, and an injector that either crashes up to
+`max_faults` random replicas (the reference's `PoisonPill` — here the
+replica endpoint is torn off the transport so it goes silent) or flips them
+to the `byzantine` behavior via the `Compromise` backdoor
+(`BFTABDNode.scala:380-381`).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import random
+
+from dds_tpu.core import messages as M
+from dds_tpu.core.transport import Transport
+
+log = logging.getLogger("dds.trudy")
+
+
+class AttackType(enum.Enum):
+    CRASH = "crash"
+    BYZANTINE = "byzantine"
+
+
+def parse_attack(name: str) -> AttackType:
+    """`MaliciousAttack.parse` equivalent; raises on unknown attack names."""
+    try:
+        return AttackType(name.strip().lower())
+    except ValueError:
+        raise ValueError(f"unknown attack type {name!r} (crash|byzantine)")
+
+
+class Trudy:
+    def __init__(self, net: Transport, replicas: list[str], max_faults: int = 2,
+                 rng: random.Random | None = None):
+        self.net = net
+        self.replicas = list(replicas)
+        self.max_faults = max_faults
+        self._rng = rng or random.Random()
+
+    def trigger(self, attack: AttackType | str) -> list[str]:
+        """Attack up to max_faults random replicas; returns the victims."""
+        if isinstance(attack, str):
+            attack = parse_attack(attack)
+        victims = self._rng.sample(self.replicas, min(self.max_faults, len(self.replicas)))
+        for v in victims:
+            if attack is AttackType.CRASH:
+                log.info("Trudy crashes %s", v)
+                self.net.unregister(v)  # node goes silent (PoisonPill analogue)
+            else:
+                log.info("Trudy compromises %s", v)
+                self.net.send("trudy", v, M.Compromise())
+        return victims

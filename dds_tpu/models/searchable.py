@@ -7,13 +7,17 @@ decrypting.
 
 Wire format (all base64, '.'-joined):  nonce.ciphertext.tag1.tag2...
 where  ct = AES-256-CTR(k_enc, nonce, pt)  and  tag_i = HMAC(k_tag, word_i)[:12].
+
+The nonce is SIV-style (a PRF of the plaintext), making encryption
+deterministic: the proxy's `SearchEntry*` routes match records by ciphertext
+equality (`DDSRestServer.scala:849-929` uses `HomoDet.compare`, i.e. string
+equality), which requires equal plaintexts to encrypt equal.
 """
 
 from __future__ import annotations
 
 import hashlib
 import hmac
-import secrets
 from dataclasses import dataclass
 
 from dds_tpu.models._symmetric import aes_ctr as _aes_ctr, b64d_url as _unb64, b64e_url as _b64
@@ -28,7 +32,10 @@ class SearchKey:
         return _b64(hmac.new(self.k_tag, word.encode(), hashlib.sha256).digest()[:12])
 
     def encrypt(self, pt: str) -> str:
-        nonce = secrets.token_bytes(16)
+        # SIV nonce keyed with k_enc, NOT k_tag: trapdoors/tags are public
+        # HMAC(k_tag, word) values, so a k_tag-derived nonce would collide
+        # with the tag of a 'siv|...' word and leak record equality
+        nonce = hmac.new(self.k_enc, b"siv|" + pt.encode(), hashlib.sha256).digest()[:16]
         ct = _aes_ctr(self.k_enc, nonce, pt.encode())
         tags = sorted({self._tag(w) for w in pt.split()})
         return ".".join([_b64(nonce), _b64(ct), *tags])

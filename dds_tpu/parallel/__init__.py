@@ -1,0 +1,7 @@
+"""Multi-chip parallelism: mesh-sharded ciphertext batch operations."""
+
+from dds_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    sharded_reduce_mul,
+    sharded_pow_mod,
+)

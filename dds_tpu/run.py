@@ -1,0 +1,221 @@
+"""System bootstrap: the `Main.scala` equivalent.
+
+Builds the full deployment from one typed config — transport, supervisor,
+replicas (putting sentinels to sleep), REST proxy, N workload clients, and
+the Trudy attack trigger — mirroring the boot call stack in SURVEY.md §3.1.
+
+Run a self-contained node + workload:
+
+    python -m dds_tpu.run --ops 100 --backend tpu
+    python -m dds_tpu.run --config configs/default.toml
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import random
+from dataclasses import dataclass, field
+
+from dds_tpu.clt.client import ClientConfig, DDSHttpClient
+from dds_tpu.clt.generator import generate
+from dds_tpu.clt.instructions import Digest
+from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
+from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
+from dds_tpu.core.supervisor import BFTSupervisor, SupervisorConfig
+from dds_tpu.core.transport import InMemoryNet, TcpNet
+from dds_tpu.http.server import DDSRestServer, ProxyConfig
+from dds_tpu.malicious.trudy import Trudy
+from dds_tpu.models.facade import HomoProvider
+from dds_tpu.utils.config import DDSConfig
+
+log = logging.getLogger("dds.run")
+
+SUPERVISOR_NAME = "supervisor"
+
+
+@dataclass
+class Deployment:
+    cfg: DDSConfig
+    net: object
+    replicas: dict[str, BFTABDNode]
+    supervisor: BFTSupervisor
+    server: DDSRestServer
+    trudy: Trudy
+    _stoppables: list = field(default_factory=list)
+
+    async def stop(self) -> None:
+        await self.supervisor.stop()
+        await self.server.stop()
+        for s in self._stoppables:
+            await s.stop()
+
+
+async def launch(cfg: DDSConfig | None = None) -> Deployment:
+    cfg = cfg or DDSConfig()
+    stoppables = []
+
+    # transport fabric (SURVEY.md §5.8: control plane stays on CPU/asyncio)
+    if cfg.transport.kind == "tcp":
+        net = TcpNet(
+            cfg.transport.host,
+            cfg.transport.port,
+            frame_secret=cfg.security.transport_frame_secret.encode() or None,
+        )
+        await net.start()
+        stoppables.append(net)
+    else:
+        net = InMemoryNet()
+
+    rcfg = ReplicaConfig(
+        quorum_size=cfg.replicas.byz_quorum_size,
+        nonce_increment=cfg.security.nonce_challenge_increment,
+        abd_mac_secret=cfg.security.abd_mac_secret.encode(),
+        proxy_mac_secret=cfg.security.proxy_mac_secret.encode(),
+        debug=cfg.debug,
+    )
+
+    endpoints = list(cfg.replicas.endpoints)
+    sentinent = [e for e in endpoints if e in set(cfg.replicas.sentinent)]
+    active = [e for e in endpoints if e not in set(cfg.replicas.sentinent)]
+
+    replicas = {
+        e: BFTABDNode(e, endpoints, SUPERVISOR_NAME, net, rcfg) for e in endpoints
+    }
+    for e in sentinent:
+        replicas[e].behavior = "sentinent"  # Main.scala:96-98
+
+    async def redeploy(endpoint: str) -> None:
+        replicas[endpoint] = BFTABDNode(endpoint, endpoints, SUPERVISOR_NAME, net, rcfg)
+
+    supervisor = BFTSupervisor(
+        SUPERVISOR_NAME,
+        active,
+        sentinent,
+        net,
+        SupervisorConfig(
+            quorum_size=cfg.replicas.byz_quorum_size,
+            proactive_recovery_warmup=cfg.recovery.warm_up,
+            proactive_recovery_interval=cfg.recovery.interval,
+            sentinent_awake_timeout=cfg.recovery.sentinent_awake_timeout,
+            crashed_recovery_timeout=cfg.recovery.crashed_recovery_timeout,
+            proactive_recovery_enabled=cfg.recovery.enabled,
+            debug=cfg.debug,
+        ),
+        redeploy=redeploy,
+    )
+    supervisor.start()
+
+    abd = AbdClient(
+        "proxy-0",
+        net,
+        active,
+        AbdClientConfig(
+            proxy_mac_secret=cfg.security.proxy_mac_secret.encode(),
+            nonce_increment=cfg.security.nonce_challenge_increment,
+            request_timeout=cfg.proxy.intranet_request_timeout,
+        ),
+    )
+    server = DDSRestServer(
+        abd,
+        ProxyConfig(
+            host=cfg.proxy.host,
+            port=cfg.proxy.port,
+            retry_backoff=cfg.proxy.retry_backoff,
+            retry_attempts=cfg.proxy.retry_attempts,
+            crypto_backend=cfg.proxy.crypto_backend,
+            key_sync_enabled=cfg.proxy.key_sync_enabled,
+            key_sync_warmup=cfg.proxy.key_sync_warm_up,
+            key_sync_interval=cfg.proxy.key_sync_interval,
+            peers=cfg.proxy.remote_peers,
+            supervisor=SUPERVISOR_NAME,
+        ),
+    )
+    await server.start()
+
+    trudy = Trudy(net, active, cfg.replicas.byz_max_faults)
+    return Deployment(cfg, net, replicas, supervisor, server, trudy, stoppables)
+
+
+async def run_workload(dep: Deployment, provider: HomoProvider | None = None,
+                       seed: int | None = None):
+    """Spawn the configured clients and drive generated digests; returns reports."""
+    cfg = dep.cfg
+    provider = provider or HomoProvider.generate(
+        cfg.client.paillier_bits, cfg.client.rsa_bits
+    )
+    rng = random.Random(seed)
+    dep.trudy._rng = rng  # make --seed reproduce attack victim selection
+    dt = cfg.client.data_table
+    if cfg.attacks.enabled:
+        # fire mid-run like the reference (Main.scala:187-193): the workload
+        # below must complete correct quorums against a damaged cluster
+        asyncio.get_event_loop().call_later(
+            0.1, lambda: dep.trudy.trigger(cfg.attacks.type)
+        )
+    runs = []
+    for i in range(cfg.client.nr_of_local_clients):
+        client = DDSHttpClient(
+            provider,
+            ClientConfig(
+                proxies=[f"{cfg.proxy.host}:{dep.server.cfg.port}"],
+                request_timeout=cfg.client.http_requests_timeout,
+                fixed_columns=dt.fixed_nr_of_columns,
+                schema=dt.fixed_columns_hcrypt,
+            ),
+            rng=random.Random(rng.getrandbits(64)),
+        )
+        ops = generate(
+            cfg.client.nr_of_operations,
+            cfg.client.proportions or None,
+            dt.max_nr_of_columns,
+            dt.fixed_columns_mappings,
+            dt.fixed_columns_hcrypt,
+            rng=random.Random(rng.getrandbits(64)),
+        )
+        runs.append(client.execute(Digest(ops)))
+    # clients run concurrently, like the reference's N client actors
+    return list(await asyncio.gather(*runs))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="Run a DDS node + workload")
+    ap.add_argument("--config", help="TOML/JSON config path")
+    ap.add_argument("--ops", type=int, help="override nr-of-operations")
+    ap.add_argument("--backend", choices=["cpu", "tpu"], help="crypto backend")
+    ap.add_argument("--port", type=int, help="proxy port (0 = auto)")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--serve", action="store_true", help="keep serving after workload")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(name)s %(message)s")
+    cfg = DDSConfig.load(args.config) if args.config else DDSConfig()
+    if args.ops is not None:
+        cfg.client.nr_of_operations = args.ops
+    if args.backend:
+        cfg.proxy.crypto_backend = args.backend
+    if args.port is not None:
+        cfg.proxy.port = args.port
+
+    async def go():
+        dep = await launch(cfg)
+        try:
+            reports = await run_workload(dep, seed=args.seed)
+            for i, r in enumerate(reports):
+                print(
+                    f"client {i}: {r.operations} ops in {r.wall_seconds:.2f}s "
+                    f"-> {r.ops_per_second:.1f} ops/s "
+                    f"({r.succeeded} ok, {r.not_found} miss, {r.failed} failed)"
+                )
+            if args.serve:
+                print(f"serving on {cfg.proxy.host}:{dep.server.cfg.port} (ctrl-c to stop)")
+                await asyncio.Event().wait()
+        finally:
+            await dep.stop()
+
+    asyncio.run(go())
+
+
+if __name__ == "__main__":
+    main()

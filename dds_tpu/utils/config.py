@@ -1,0 +1,151 @@
+"""Typed configuration: one dataclass tree, loadable from TOML or JSON.
+
+Replaces the reference's two HOCON files (`dds-system.conf`, `client.conf`)
+with the same parameter catalog — topology with sentinent flags, quorum
+sizes, proactive-recovery timers, proxy/key-sync settings, MAC secrets,
+workload proportions, column schema, attack simulation — as explicit typed
+fields (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ReplicaTopology:
+    endpoints: list[str] = field(
+        default_factory=lambda: [f"replica-{i}" for i in range(9)]
+    )
+    sentinent: list[str] = field(
+        default_factory=lambda: ["replica-7", "replica-8"]
+    )
+    byz_quorum_size: int = 5           # dds-system.conf:131
+    byz_max_faults: int = 2            # dds-system.conf:132
+
+
+@dataclass
+class SecurityConfig:
+    abd_mac_secret: str = "intranet-abd-secret"
+    proxy_mac_secret: str = "rest2abd"          # dds-system.conf:94 default
+    nonce_challenge_increment: int = 1
+    transport_frame_secret: str = ""            # empty -> unauthenticated frames
+
+
+@dataclass
+class RecoveryConfig:
+    enabled: bool = True
+    warm_up: float = 5.0               # dds-system.conf:137
+    interval: float = 7.0              # dds-system.conf:138
+    sentinent_awake_timeout: float = 5.0
+    crashed_recovery_timeout: float = 12.0
+
+
+@dataclass
+class ProxySettings:
+    host: str = "127.0.0.1"
+    port: int = 8443
+    crypto_backend: str = "cpu"        # the BASELINE.json crypto.backend switch
+    intranet_request_timeout: float = 5.0
+    retry_attempts: int = 2
+    retry_backoff: float = 0.3
+    key_sync_enabled: bool = False
+    key_sync_warm_up: float = 1.0
+    key_sync_interval: float = 5.0
+    remote_peers: list[str] = field(default_factory=list)
+
+
+@dataclass
+class TransportConfig:
+    kind: str = "memory"               # memory | tcp
+    host: str = "127.0.0.1"
+    port: int = 2552
+
+
+@dataclass
+class DataTableConfig:
+    max_nr_of_columns: int = 16
+    fixed_nr_of_columns: int = 8
+    fixed_columns_mappings: list[str] = field(
+        default_factory=lambda: ["Int", "String", "Int", "Int", "String", "String", "String", "Blob"]
+    )
+    fixed_columns_hcrypt: list[str] = field(
+        default_factory=lambda: ["OPE", "CHE", "PSSE", "MSE", "CHE", "CHE", "CHE", "None"]
+    )
+
+
+@dataclass
+class ClientSettings:
+    nr_of_local_clients: int = 1
+    nr_of_operations: int = 100
+    failed_contact_attempts_threshold: int = 3
+    http_requests_timeout: float = 10.0
+    proportions: dict = field(default_factory=dict)   # op name -> fraction
+    data_table: DataTableConfig = field(default_factory=DataTableConfig)
+    paillier_bits: int = 2048
+    rsa_bits: int = 1024
+
+
+@dataclass
+class AttackConfig:
+    enabled: bool = False
+    type: str = "byzantine"            # crash | byzantine
+
+
+@dataclass
+class DDSConfig:
+    replicas: ReplicaTopology = field(default_factory=ReplicaTopology)
+    security: SecurityConfig = field(default_factory=SecurityConfig)
+    recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
+    proxy: ProxySettings = field(default_factory=ProxySettings)
+    transport: TransportConfig = field(default_factory=TransportConfig)
+    client: ClientSettings = field(default_factory=ClientSettings)
+    attacks: AttackConfig = field(default_factory=AttackConfig)
+    debug: bool = False
+
+    # ------------------------------------------------------------- loading
+
+    @staticmethod
+    def _build(cls, data):
+        if dataclasses.is_dataclass(cls) and isinstance(data, dict):
+            fields = {f.name: f for f in dataclasses.fields(cls)}
+            kwargs = {}
+            for k, v in data.items():
+                k = k.replace("-", "_")
+                if k not in fields:
+                    raise ValueError(f"unknown config key {k!r} for {cls.__name__}")
+                ftype = fields[k].type
+                sub = _SUBSECTIONS.get((cls.__name__, k))
+                kwargs[k] = DDSConfig._build(sub, v) if sub else v
+            return cls(**kwargs)
+        return data
+
+    @staticmethod
+    def from_dict(data: dict) -> "DDSConfig":
+        return DDSConfig._build(DDSConfig, data)
+
+    @staticmethod
+    def load(path: str | pathlib.Path) -> "DDSConfig":
+        p = pathlib.Path(path)
+        if p.suffix == ".toml":
+            import tomllib
+
+            data = tomllib.loads(p.read_text())
+        else:
+            data = json.loads(p.read_text())
+        return DDSConfig.from_dict(data)
+
+
+_SUBSECTIONS = {
+    ("DDSConfig", "replicas"): ReplicaTopology,
+    ("DDSConfig", "security"): SecurityConfig,
+    ("DDSConfig", "recovery"): RecoveryConfig,
+    ("DDSConfig", "proxy"): ProxySettings,
+    ("DDSConfig", "transport"): TransportConfig,
+    ("DDSConfig", "client"): ClientSettings,
+    ("DDSConfig", "attacks"): AttackConfig,
+    ("ClientSettings", "data_table"): DataTableConfig,
+}

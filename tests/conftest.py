@@ -18,4 +18,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The environment's sitecustomize may import jax before this file runs (it
+# registers the TPU plugin for every interpreter), in which case jax has
+# already captured JAX_PLATFORMS from the parent env — override via config.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))

@@ -140,3 +140,21 @@ def test_backend_powmod_parity():
 def test_unknown_backend():
     with pytest.raises(ValueError):
         get_backend("gpu")
+
+
+def test_generator_proportions_replace_defaults():
+    from dds_tpu.clt.generator import generate
+
+    ops = generate(100, {"put-set": 0.5, "get-set": 0.5}, rng=random.Random(1))
+    assert len(ops) == 100  # nothing leaks in from the defaults
+    with pytest.raises(ValueError):
+        generate(10, {"no-such-op": 1.0})
+
+
+def test_searchable_trapdoor_nonce_domain_separation():
+    k = KEYS.lse
+    # the public trapdoor of a 'siv|'-prefixed word must not equal the
+    # nonce component of any record's ciphertext
+    c = k.encrypt("alice")
+    nonce_field = c.split(".")[0]
+    assert k.trapdoor("siv|alice") != nonce_field[: len(k.trapdoor("siv|alice"))]
