@@ -52,29 +52,65 @@ class CpuBackend:
         return [pow(b, exp, modulus) for b in bases]
 
 
+def _use_pallas() -> bool:
+    """Compiled Pallas kernels on real TPU; jnp reference path elsewhere.
+
+    Override with DDS_PALLAS=1 (force, incl. interpret mode on CPU) or
+    DDS_PALLAS=0 (force the jnp path even on TPU).
+    """
+    import os
+
+    flag = os.environ.get("DDS_PALLAS", "").strip().lower()
+    if flag:
+        return flag not in ("0", "false", "off", "no")
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 class TpuBackend:
     """Batched limb-tensor backend on the tier-0 Montgomery kernels.
 
-    Works on whatever JAX's default platform is (the real TPU chip in
-    deployment; XLA-CPU in tests). Compiled kernels are cached per modulus
-    via ModCtx.make's lru_cache.
+    On a real TPU the fused Pallas CIOS kernels run (ops/pallas_mont);
+    elsewhere (XLA-CPU in tests) the portable jnp path. Compiled kernels
+    are cached per modulus via ModCtx.make's lru_cache.
     """
 
     name = "tpu"
 
+    def __init__(self, pallas: bool | None = None):
+        self.pallas = _use_pallas() if pallas is None else pallas
+
     def modmul(self, c1: int, c2: int, modulus: int) -> int:
         return self.modmul_fold([c1, c2], modulus)
+
+    def reduce_mul_device(self, ctx: ModCtx, batch):
+        """Modular product over an already-resident (K, L) limb batch.
+
+        The device-level fold entry point shared by modmul_fold, the
+        proxy's aggregate routes, and bench.py — one dispatch rule."""
+        if self.pallas:
+            from dds_tpu.ops import pallas_mont
+
+            return pallas_mont.reduce_mul(ctx, batch)
+        return ctx.reduce_mul(batch)
 
     def modmul_fold(self, cs: list[int], modulus: int) -> int:
         ctx = ModCtx.make(modulus)
         batch = bn.ints_to_batch(cs, ctx.L)
-        out = ctx.reduce_mul(batch)
+        out = self.reduce_mul_device(ctx, batch)
         return bn.limbs_to_int(np.asarray(out)[0])
 
     def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
         ctx = ModCtx.make(modulus)
         batch = bn.ints_to_batch(bases, ctx.L)
-        return bn.batch_to_ints(np.asarray(ctx.pow_mod(batch, exp)))
+        if self.pallas:
+            from dds_tpu.ops import pallas_mont
+
+            out = pallas_mont.pow_mod(ctx, batch, exp)
+        else:
+            out = ctx.pow_mod(batch, exp)
+        return bn.batch_to_ints(np.asarray(out))
 
 
 _BACKENDS = {"cpu": CpuBackend, "tpu": TpuBackend}
