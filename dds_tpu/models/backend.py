@@ -113,7 +113,28 @@ class TpuBackend:
         return bn.batch_to_ints(np.asarray(out))
 
 
-_BACKENDS = {"cpu": CpuBackend, "tpu": TpuBackend}
+class NativeBackend:
+    """Host-side C++ CIOS backend (dds_tpu.native) — the accelerated CPU
+    path for hosts without a TPU; falls back to python ints if the native
+    library is unavailable."""
+
+    name = "native"
+
+    def modmul(self, c1: int, c2: int, modulus: int) -> int:
+        return c1 * c2 % modulus
+
+    def modmul_fold(self, cs: list[int], modulus: int) -> int:
+        from dds_tpu import native
+
+        return native.fold(cs, modulus)
+
+    def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
+        from dds_tpu import native
+
+        return native.powmod_batch(bases, exp, modulus)
+
+
+_BACKENDS = {"cpu": CpuBackend, "tpu": TpuBackend, "native": NativeBackend}
 
 
 def get_backend(name: str) -> CryptoBackend:

@@ -16,6 +16,8 @@ from dataclasses import dataclass
 
 from cryptography.hazmat.primitives.asymmetric import rsa
 
+from dds_tpu.native import powmod
+
 
 @dataclass(frozen=True)
 class RsaMultPublicKey:
@@ -23,7 +25,7 @@ class RsaMultPublicKey:
     e: int = 65537
 
     def encrypt(self, m: int) -> int:
-        return pow(m % self.n, self.e, self.n)
+        return powmod(m % self.n, self.e, self.n)
 
     def mult(self, c1: int, c2: int) -> int:
         return c1 * c2 % self.n
@@ -60,8 +62,8 @@ class RsaMultKey:
 
     def decrypt(self, c: int) -> int:
         # CRT decryption: two half-size modexps.
-        mp = pow(c % self.p, self.d % (self.p - 1), self.p)
-        mq = pow(c % self.q, self.d % (self.q - 1), self.q)
+        mp = powmod(c % self.p, self.d % (self.p - 1), self.p)
+        mq = powmod(c % self.q, self.d % (self.q - 1), self.q)
         qinv = pow(self.q, -1, self.p)
         u = (mp - mq) * qinv % self.p
         return mq + u * self.q

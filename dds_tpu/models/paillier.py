@@ -24,6 +24,8 @@ from math import gcd
 
 from cryptography.hazmat.primitives.asymmetric import rsa
 
+from dds_tpu.native import powmod
+
 
 def _lcm(a: int, b: int) -> int:
     return a // gcd(a, b) * b
@@ -43,7 +45,7 @@ class PaillierPublicKey:
         if r is None:
             r = self.random_r()
         # (1 + m n) r^n mod n^2
-        return (1 + m * n) % n2 * pow(r, n, n2) % n2
+        return (1 + m * n) % n2 * powmod(r, n, n2) % n2
 
     def random_r(self) -> int:
         n = self.n
@@ -56,7 +58,7 @@ class PaillierPublicKey:
         return c1 * c2 % self.nsquare
 
     def scalar_mul(self, c: int, k: int) -> int:
-        return pow(c, k, self.nsquare)
+        return powmod(c, k, self.nsquare)
 
 
 @dataclass(frozen=True)
@@ -101,8 +103,8 @@ class PaillierKey:
     def decrypt(self, c: int) -> int:
         p, q, n = self.p, self.q, self.n
         hp, hq, qinv = self._crt_params()
-        mp = (pow(c % (p * p), p - 1, p * p) - 1) // p % p * hp % p
-        mq = (pow(c % (q * q), q - 1, q * q) - 1) // q % q * hq % q
+        mp = (powmod(c % (p * p), p - 1, p * p) - 1) // p % p * hp % p
+        mq = (powmod(c % (q * q), q - 1, q * q) - 1) // q % q * hq % q
         u = (mp - mq) * qinv % p
         return (mq + u * q) % n
 

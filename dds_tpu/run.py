@@ -183,7 +183,7 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="Run a DDS node + workload")
     ap.add_argument("--config", help="TOML/JSON config path")
     ap.add_argument("--ops", type=int, help="override nr-of-operations")
-    ap.add_argument("--backend", choices=["cpu", "tpu"], help="crypto backend")
+    ap.add_argument("--backend", choices=["cpu", "tpu", "native"], help="crypto backend")
     ap.add_argument("--port", type=int, help="proxy port (0 = auto)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--serve", action="store_true", help="keep serving after workload")
