@@ -28,6 +28,7 @@ from dds_tpu.core.errors import (
     ByzUnknownReplyError,
 )
 from dds_tpu.core.transport import Transport
+from dds_tpu.utils.trace import tracer
 from dds_tpu.utils import sigs
 from dds_tpu.utils.trust import TrustedNodesList
 
@@ -99,7 +100,8 @@ class AbdClient:
         """Quorum read; returns the stored set (list) or None."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce)
-        reply, coord, challenge = await self._ask(M.IRead(key), nonce, sig)
+        with tracer.span("abd.fetch"):
+            reply, coord, challenge = await self._ask(M.IRead(key), nonce, sig)
 
         cfg = self.cfg
         match reply:
@@ -124,7 +126,8 @@ class AbdClient:
         """Quorum write (value=None removes); returns the key on success."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce, value)
-        reply, coord, challenge = await self._ask(M.IWrite(key, value), nonce, sig)
+        with tracer.span("abd.write"):
+            reply, coord, challenge = await self._ask(M.IWrite(key, value), nonce, sig)
 
         cfg = self.cfg
         match reply:

@@ -1,0 +1,68 @@
+"""Optional snapshot-to-disk for replica state.
+
+The reference has no disk persistence: durability comes from replication
+only, with live `State(data, nonces)` transfer re-seeding recovered nodes
+(SURVEY.md §5.4, `BFTABDNode.scala:368-375,413-416`). We keep that model
+— snapshots are an *additional* cold-start accelerator, not the source of
+truth: a restored replica rejoins with a possibly-stale repository and the
+ABD read/write-back protocol repairs it per-key (same argument as spare
+promotion).
+
+Format: one JSON file per replica: {"repository": {key: [seq, id, value]},
+"expired_nonces": [...]} — value is the JSON row (list) or null.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+
+from dds_tpu.core import messages as M
+from dds_tpu.core.replica import BFTABDNode
+
+
+def save_replica(node: BFTABDNode, directory: str | os.PathLike) -> pathlib.Path:
+    """Write the node's repository + anti-replay state atomically."""
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / f"{node.name}.snapshot.json"
+    state = {
+        "repository": {
+            k: [t.seq, t.id, v] for k, (t, v) in node.repository.items()
+        },
+        "expired_nonces": sorted(
+            n for n, expired in node.incoming.items() if expired
+        ),
+    }
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(state))
+    os.replace(tmp, path)
+    return path
+
+
+def load_replica(node: BFTABDNode, directory: str | os.PathLike) -> bool:
+    """Restore a prior snapshot into the node, if one exists."""
+    path = pathlib.Path(directory) / f"{node.name}.snapshot.json"
+    if not path.exists():
+        return False
+    state = json.loads(path.read_text())
+    node.repository = {
+        k: (M.ABDTag(seq, tid), v)
+        for k, (seq, tid, v) in (
+            (k, tuple(entry)) for k, entry in state["repository"].items()
+        )
+    }
+    for n in state.get("expired_nonces", []):
+        node.incoming[int(n)] = True
+    return True
+
+
+def save_all(replicas: dict[str, BFTABDNode], directory: str | os.PathLike) -> int:
+    for node in replicas.values():
+        save_replica(node, directory)
+    return len(replicas)
+
+
+def load_all(replicas: dict[str, BFTABDNode], directory: str | os.PathLike) -> int:
+    return sum(1 for node in replicas.values() if load_replica(node, directory))

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import ssl
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -36,6 +37,7 @@ from dds_tpu.http.miniserver import HttpServer, Request, Response, http_request
 from dds_tpu.models.backend import CryptoBackend, get_backend
 from dds_tpu.utils import sigs
 from dds_tpu.utils.retry import retry
+from dds_tpu.utils.trace import tracer
 
 log = logging.getLogger("dds.rest")
 
@@ -110,6 +112,11 @@ class DDSRestServer:
                         ssl_context=self.cfg.ssl_client_context,
                         timeout=5.0,
                     )
+                except ssl.SSLError as e:
+                    # loud: under mutual TLS this usually means the peer has
+                    # a different CA (per-node dev certs on a multi-host
+                    # deployment — see SecurityConfig.tls_ca)
+                    log.warning("key-sync peer %s TLS failure: %s", peer, e)
                 except OSError:
                     log.debug("key-sync peer %s unreachable", peer)
                 except asyncio.TimeoutError:
@@ -152,8 +159,10 @@ class DDSRestServer:
     # -------------------------------------------------------------- routing
 
     async def handle(self, req: Request) -> Response:
+        route = req.path.split("/", 2)[1] if "/" in req.path else req.path
         try:
-            return await self._route(req)
+            with tracer.span(f"http.{req.method}.{route or 'root'}"):
+                return await self._route(req)
         except (ValueError, KeyError, TypeError) as e:
             return Response.text(f"bad request: {e}", 400)
         except Exception:
@@ -351,9 +360,15 @@ class DDSRestServer:
         if not operands:
             return Response(404)
         if mod:
-            result = self.backend.modmul_fold(
-                operands, self._parse_modulus(mod, modparam)
-            )
+            modulus = self._parse_modulus(mod, modparam)
+            # device-resident path when the backend has a cipher store:
+            # quorum reads above are still authoritative; the store only
+            # memoizes limb conversion + transfer (ops/store.py)
+            fold_resident = getattr(self.backend, "modmul_fold_resident", None)
+            if fold_resident is not None:
+                result = fold_resident(operands, modulus)
+            else:
+                result = self.backend.modmul_fold(operands, modulus)
         elif modparam == "nsqr":
             result = sum(operands)
         else:

@@ -80,6 +80,25 @@ class TpuBackend:
 
     def __init__(self, pallas: bool | None = None):
         self.pallas = _use_pallas() if pallas is None else pallas
+        self._stores: dict[int, object] = {}
+
+    def store_for(self, modulus: int):
+        """Per-modulus device-resident cipher store (ops/store.py)."""
+        store = self._stores.get(modulus)
+        if store is None:
+            from dds_tpu.ops.store import DeviceCipherStore
+
+            ctx = ModCtx.make(modulus)
+            store = DeviceCipherStore(
+                modulus, reduce=lambda rows: self.reduce_mul_device(ctx, rows)
+            )
+            self._stores[modulus] = store
+        return store
+
+    def modmul_fold_resident(self, cs: list[int], modulus: int) -> int:
+        """Fold via the device store: unseen ciphertexts ingest once, the
+        aggregate gathers resident rows on-device."""
+        return self.store_for(modulus).fold(cs)
 
     def modmul(self, c1: int, c2: int, modulus: int) -> int:
         return self.modmul_fold([c1, c2], modulus)

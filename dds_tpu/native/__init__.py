@@ -58,34 +58,36 @@ def _build() -> pathlib.Path | None:
 
 def _load():
     global _LIB, _TRIED
+    if _TRIED:  # lock-free fast path: _LIB is assigned before _TRIED flips
+        return _LIB
     with _LOCK:
         if _TRIED:
             return _LIB
-        _TRIED = True
-        if os.environ.get("DDS_NATIVE", "").strip().lower() in ("0", "false", "off", "no"):
-            return None
-        so = _build()
-        if so is None:
-            return None
-        try:
-            lib = ctypes.CDLL(str(so))
-            assert lib.ddsbn_abi_version() == 1
-            u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
-            lib.ddsbn_mont_mul.argtypes = [
-                ctypes.c_int, u64p, ctypes.c_uint64, u64p, u64p, u64p]
-            lib.ddsbn_fold.argtypes = [
-                ctypes.c_int, u64p, ctypes.c_uint64, u64p, ctypes.c_long,
-                u64p, u64p]
-            lib.ddsbn_exp.argtypes = [
-                ctypes.c_int, u64p, ctypes.c_uint64, u64p, u64p, u64p,
-                ctypes.c_int, u64p]
-            lib.ddsbn_exp_batch.argtypes = [
-                ctypes.c_int, u64p, ctypes.c_uint64, u64p, u64p,
-                ctypes.c_long, u64p, ctypes.c_int, u64p]
-            _LIB = lib
-        except (OSError, AssertionError, AttributeError) as e:
-            log.warning("native bignum load failed (%s); using python ints", e)
-            _LIB = None
+        lib = None
+        disabled = os.environ.get("DDS_NATIVE", "").strip().lower() in (
+            "0", "false", "off", "no")
+        so = None if disabled else _build()
+        if so is not None:
+            try:
+                lib = ctypes.CDLL(str(so))
+                assert lib.ddsbn_abi_version() == 1
+                u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+                lib.ddsbn_mont_mul.argtypes = [
+                    ctypes.c_int, u64p, ctypes.c_uint64, u64p, u64p, u64p]
+                lib.ddsbn_fold.argtypes = [
+                    ctypes.c_int, u64p, ctypes.c_uint64, u64p, ctypes.c_long,
+                    u64p, u64p]
+                lib.ddsbn_exp.argtypes = [
+                    ctypes.c_int, u64p, ctypes.c_uint64, u64p, u64p, u64p,
+                    ctypes.c_int, u64p]
+                lib.ddsbn_exp_batch.argtypes = [
+                    ctypes.c_int, u64p, ctypes.c_uint64, u64p, u64p,
+                    ctypes.c_long, u64p, ctypes.c_int, u64p]
+            except (OSError, AssertionError, AttributeError) as e:
+                log.warning("native bignum load failed (%s); using python ints", e)
+                lib = None
+        _LIB = lib
+        _TRIED = True  # set after _LIB so fast-path readers see a settled value
         return _LIB
 
 

@@ -43,6 +43,7 @@ class Deployment:
     supervisor: BFTSupervisor
     server: DDSRestServer
     trudy: Trudy
+    ssl_client: object = None
     _stoppables: list = field(default_factory=list)
 
     async def stop(self) -> None:
@@ -55,6 +56,25 @@ class Deployment:
 async def launch(cfg: DDSConfig | None = None) -> Deployment:
     cfg = cfg or DDSConfig()
     stoppables = []
+
+    # mutual TLS on the HTTP hops (SURVEY §2.14/§2.20 posture, configurable)
+    ssl_server = ssl_client = None
+    if cfg.security.tls_enabled:
+        from dds_tpu.utils import tlsutil
+
+        sec = cfg.security
+        if sec.tls_ca and sec.tls_cert and sec.tls_key:
+            ca, cert, key = sec.tls_ca, sec.tls_cert, sec.tls_key
+        else:
+            # dev fallback: per-node CA — single-host only (see SecurityConfig)
+            paths = tlsutil.generate_ca_and_cert(
+                sec.tls_dir, hosts=(cfg.proxy.host, "localhost")
+            )
+            ca, cert, key = paths["ca"], paths["cert"], paths["key"]
+        ssl_server = tlsutil.server_context(cert, key, ca)
+        ssl_client = tlsutil.client_context(
+            ca, cert, key, verify_hostname=sec.tls_verify_hostname
+        )
 
     # transport fabric (SURVEY.md §5.8: control plane stays on CPU/asyncio)
     if cfg.transport.kind == "tcp":
@@ -85,6 +105,15 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     }
     for e in sentinent:
         replicas[e].behavior = "sentinent"  # Main.scala:96-98
+
+    # optional snapshot restore + periodic save (core/snapshot.py)
+    if cfg.recovery.snapshot_dir:
+        from dds_tpu.core import snapshot as snap
+
+        restored = snap.load_all(replicas, cfg.recovery.snapshot_dir)
+        if restored:
+            log.info("restored %d replica snapshots from %s", restored,
+                     cfg.recovery.snapshot_dir)
 
     async def redeploy(endpoint: str) -> None:
         replicas[endpoint] = BFTABDNode(endpoint, endpoints, SUPERVISOR_NAME, net, rcfg)
@@ -130,12 +159,40 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             key_sync_interval=cfg.proxy.key_sync_interval,
             peers=cfg.proxy.remote_peers,
             supervisor=SUPERVISOR_NAME,
+            ssl_server_context=ssl_server,
+            ssl_client_context=ssl_client,
         ),
     )
     await server.start()
 
     trudy = Trudy(net, active, cfg.replicas.byz_max_faults)
-    return Deployment(cfg, net, replicas, supervisor, server, trudy, stoppables)
+    dep = Deployment(cfg, net, replicas, supervisor, server, trudy, ssl_client,
+                     stoppables)
+
+    if cfg.recovery.snapshot_dir and cfg.recovery.snapshot_interval > 0:
+        from dds_tpu.core import snapshot as snap
+
+        async def _snapshot_loop():
+            while True:
+                await asyncio.sleep(cfg.recovery.snapshot_interval)
+                # off-loop: serializing large repositories must not stall
+                # ABD handling or recovery timers
+                await asyncio.to_thread(
+                    snap.save_all, dict(dep.replicas), cfg.recovery.snapshot_dir
+                )
+
+        task = asyncio.ensure_future(_snapshot_loop())
+
+        class _TaskStopper:
+            async def stop(self):
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+
+        stoppables.append(_TaskStopper())
+    return dep
 
 
 async def run_workload(dep: Deployment, provider: HomoProvider | None = None,
@@ -163,6 +220,7 @@ async def run_workload(dep: Deployment, provider: HomoProvider | None = None,
                 request_timeout=cfg.client.http_requests_timeout,
                 fixed_columns=dt.fixed_nr_of_columns,
                 schema=dt.fixed_columns_hcrypt,
+                ssl_context=dep.ssl_client,
             ),
             rng=random.Random(rng.getrandbits(64)),
         )

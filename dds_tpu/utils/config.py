@@ -33,6 +33,17 @@ class SecurityConfig:
     proxy_mac_secret: str = "rest2abd"          # dds-system.conf:94 default
     nonce_challenge_increment: int = 1
     transport_frame_secret: str = ""            # empty -> unauthenticated frames
+    # mutual TLS on the HTTP hops (certificates/ JKS analogue, SURVEY §2.20).
+    # Multi-host deployments MUST pre-provision one shared CA and per-host
+    # certs via tls_ca/tls_cert/tls_key; when those are empty a per-node
+    # dev CA auto-generates under tls_dir (single-host only — two nodes
+    # with independent CAs cannot verify each other).
+    tls_enabled: bool = False
+    tls_dir: str = "certs"
+    tls_ca: str = ""
+    tls_cert: str = ""
+    tls_key: str = ""
+    tls_verify_hostname: bool = False  # reference's accept-all verifier default
 
 
 @dataclass
@@ -42,6 +53,10 @@ class RecoveryConfig:
     interval: float = 7.0              # dds-system.conf:138
     sentinent_awake_timeout: float = 5.0
     crashed_recovery_timeout: float = 12.0
+    # optional snapshot-to-disk (SURVEY §5.4: replication stays the source
+    # of truth; snapshots only warm cold starts). 0 disables.
+    snapshot_dir: str = ""
+    snapshot_interval: float = 0.0
 
 
 @dataclass

@@ -1,0 +1,128 @@
+"""Structured tracing: per-phase timing events + counters.
+
+The reference's only observability is three debug flags gating `println`s
+and a client ops/s printout (SURVEY.md §5.1, `dds-system.conf:61-62`,
+`clt/DDSHttpClient.scala:410-415`). This module is the structured upgrade
+called for there: every subsystem records named spans (HTTP route time,
+ABD quorum RTT, crypto kernel time) into a bounded in-memory ring that can
+be summarized (count/total/mean/p95) or dumped as JSONL for offline
+analysis. Overhead is one perf_counter pair and a deque append per span.
+
+Usage:
+
+    from dds_tpu.utils.trace import tracer
+    with tracer.span("abd.fetch", key=key):
+        ...
+    tracer.count("abd.suspect")
+    print(tracer.summary())
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SpanRecord:
+    ts: float
+    name: str
+    dur_ms: float
+    meta: dict
+
+
+@dataclass
+class Tracer:
+    """Thread-safe bounded event recorder."""
+
+    max_events: int = 65536
+    enabled: bool = True
+    _events: collections.deque = field(init=False, repr=False)
+    _counters: collections.Counter = field(init=False, repr=False)
+    _lock: threading.Lock = field(init=False, repr=False)
+
+    def __post_init__(self):
+        self._events = collections.deque(maxlen=self.max_events)
+        self._counters = collections.Counter()
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **meta):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, (time.perf_counter() - t0) * 1e3, **meta)
+
+    def record(self, name: str, dur_ms: float, **meta) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(SpanRecord(time.time(), name, dur_ms, meta))
+
+    def count(self, name: str, n: int = 1) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters[name] += n
+
+    # ------------------------------------------------------------- reporting
+
+    def events(self, name: str | None = None) -> list[SpanRecord]:
+        with self._lock:
+            evs = list(self._events)
+        return [e for e in evs if name is None or e.name == name]
+
+    def counters(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def summary(self) -> dict[str, dict]:
+        """Per-span-name {count, total_ms, mean_ms, p50_ms, p95_ms}."""
+        groups: dict[str, list[float]] = collections.defaultdict(list)
+        for e in self.events():
+            groups[e.name].append(e.dur_ms)
+        out = {}
+        for name, durs in sorted(groups.items()):
+            durs.sort()
+            k = len(durs)
+            out[name] = {
+                "count": k,
+                "total_ms": round(sum(durs), 3),
+                "mean_ms": round(sum(durs) / k, 3),
+                "p50_ms": round(durs[k // 2], 3),
+                "p95_ms": round(durs[min(k - 1, int(k * 0.95))], 3),
+            }
+        for name, n in self.counters().items():
+            out.setdefault(name, {})["count"] = (
+                out.get(name, {}).get("count", 0) + n
+            )
+        return out
+
+    def dump_jsonl(self, path: str) -> int:
+        evs = self.events()
+        with open(path, "w") as f:
+            for e in evs:
+                f.write(
+                    json.dumps(
+                        {"ts": e.ts, "name": e.name, "dur_ms": e.dur_ms, **e.meta}
+                    )
+                    + "\n"
+                )
+        return len(evs)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._counters.clear()
+
+
+# process-wide default tracer (subsystems import this)
+tracer = Tracer()

@@ -1,0 +1,201 @@
+"""Tests for auxiliary subsystems: tracing, snapshots, TLS (SURVEY §5)."""
+
+import asyncio
+import json
+import ssl
+
+import pytest
+
+from dds_tpu.utils.trace import Tracer
+
+
+# ------------------------------------------------------------------- tracing
+
+
+def test_tracer_spans_and_summary():
+    t = Tracer()
+    for _ in range(3):
+        with t.span("abd.fetch", key="k"):
+            pass
+    t.count("abd.suspect", 2)
+    s = t.summary()
+    assert s["abd.fetch"]["count"] == 3
+    assert s["abd.fetch"]["p95_ms"] >= 0
+    assert s["abd.suspect"]["count"] == 2
+    assert len(t.events("abd.fetch")) == 3
+
+
+def test_tracer_disabled_records_nothing():
+    t = Tracer(enabled=False)
+    with t.span("x"):
+        pass
+    t.count("y")
+    assert t.summary() == {}
+
+
+def test_tracer_dump_jsonl(tmp_path):
+    t = Tracer()
+    with t.span("a", foo=1):
+        pass
+    p = tmp_path / "trace.jsonl"
+    assert t.dump_jsonl(str(p)) == 1
+    rec = json.loads(p.read_text().strip())
+    assert rec["name"] == "a" and rec["foo"] == 1
+
+
+def test_tracer_bounded():
+    t = Tracer(max_events=10)
+    for i in range(25):
+        t.record("e", 1.0)
+    assert len(t.events()) == 10
+
+
+# ----------------------------------------------------------------- snapshots
+
+
+def test_snapshot_roundtrip(tmp_path):
+    from dds_tpu.core import snapshot as snap
+    from dds_tpu.core.messages import ABDTag
+    from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
+    from dds_tpu.core.transport import InMemoryNet
+
+    net = InMemoryNet()
+    addrs = ["r0", "r1"]
+    node = BFTABDNode("r0", addrs, "sup", net, ReplicaConfig(quorum_size=1))
+    node.repository["k1"] = (ABDTag(3, "r0"), [1, "a", 2])
+    node.repository["k2"] = (ABDTag(1, "r1"), None)
+    node.incoming[12345] = True
+    node.incoming[99] = False
+
+    snap.save_replica(node, tmp_path)
+
+    fresh = BFTABDNode("r0", addrs, "sup", InMemoryNet(), ReplicaConfig(quorum_size=1))
+    assert snap.load_replica(fresh, tmp_path)
+    assert fresh.repository["k1"] == (ABDTag(3, "r0"), [1, "a", 2])
+    assert fresh.repository["k2"] == (ABDTag(1, "r1"), None)
+    assert fresh.incoming[12345] is True
+    assert 99 not in fresh.incoming  # only expired nonces persist
+
+
+def test_snapshot_load_missing(tmp_path):
+    from dds_tpu.core import snapshot as snap
+    from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
+    from dds_tpu.core.transport import InMemoryNet
+
+    node = BFTABDNode("rX", ["rX"], "sup", InMemoryNet(), ReplicaConfig(quorum_size=1))
+    assert not snap.load_replica(node, tmp_path)
+
+
+def test_snapshot_save_all_load_all(tmp_path):
+    from dds_tpu.core import snapshot as snap
+    from dds_tpu.core.messages import ABDTag
+    from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
+    from dds_tpu.core.transport import InMemoryNet
+
+    net = InMemoryNet()
+    addrs = ["r0", "r1", "r2"]
+    replicas = {
+        a: BFTABDNode(a, addrs, "sup", net, ReplicaConfig(quorum_size=2))
+        for a in addrs
+    }
+    replicas["r1"].repository["k"] = (ABDTag(7, "r1"), ["x"])
+    assert snap.save_all(replicas, tmp_path) == 3
+    fresh = {
+        a: BFTABDNode(a, addrs, "sup", InMemoryNet(), ReplicaConfig(quorum_size=2))
+        for a in addrs
+    }
+    assert snap.load_all(fresh, tmp_path) == 3
+    assert fresh["r1"].repository["k"] == (ABDTag(7, "r1"), ["x"])
+
+
+# ----------------------------------------------------------------------- TLS
+
+
+def test_tls_cert_generation_and_contexts(tmp_path):
+    from dds_tpu.utils import tlsutil
+
+    paths = tlsutil.generate_ca_and_cert(tmp_path, hosts=("127.0.0.1", "localhost"))
+    for p in paths.values():
+        assert p.exists()
+    # idempotent
+    again = tlsutil.generate_ca_and_cert(tmp_path)
+    assert again == paths
+
+    srv = tlsutil.server_context(paths["cert"], paths["key"], paths["ca"])
+    assert srv.verify_mode == ssl.CERT_REQUIRED
+    cli = tlsutil.client_context(paths["ca"], paths["cert"], paths["key"])
+    assert cli.check_hostname is False
+
+
+def test_mutual_tls_http_roundtrip(tmp_path):
+    """Full mutual-TLS HTTP round trip through the miniserver."""
+    from dds_tpu.http.miniserver import HttpServer, Response, http_request
+    from dds_tpu.utils import tlsutil
+
+    paths = tlsutil.generate_ca_and_cert(tmp_path)
+    srv_ctx = tlsutil.server_context(paths["cert"], paths["key"], paths["ca"])
+    cli_ctx = tlsutil.client_context(paths["ca"], paths["cert"], paths["key"])
+
+    async def go():
+        async def handler(req):
+            return Response.text("secure-ok")
+
+        server = HttpServer("127.0.0.1", 0, handler, srv_ctx)
+        await server.start()
+        try:
+            status, body = await http_request(
+                "127.0.0.1", server.port, "GET", "/", ssl_context=cli_ctx, timeout=5.0
+            )
+            assert status == 200 and body == b"secure-ok"
+            # a client WITHOUT a cert is rejected by mutual auth
+            anon = tlsutil.client_context(paths["ca"])
+            with pytest.raises((ssl.SSLError, OSError, asyncio.TimeoutError)):
+                await http_request(
+                    "127.0.0.1", server.port, "GET", "/", ssl_context=anon, timeout=5.0
+                )
+        finally:
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_launch_with_tls_and_snapshots(tmp_path):
+    """Boot the full deployment with TLS + snapshots enabled, run a client
+    op over HTTPS, snapshot, and restore into a fresh boot."""
+    import secrets
+
+    from dds_tpu.core import snapshot as snap
+    from dds_tpu.http.miniserver import http_request
+    from dds_tpu.run import launch
+    from dds_tpu.utils.config import DDSConfig
+
+    async def go():
+        cfg = DDSConfig()
+        cfg.security.tls_enabled = True
+        cfg.security.tls_dir = str(tmp_path / "certs")
+        cfg.recovery.snapshot_dir = str(tmp_path / "snaps")
+        cfg.recovery.enabled = False
+        cfg.proxy.port = 0
+        dep = await launch(cfg)
+        try:
+            body = json.dumps({"contents": [1, 2, 3]}).encode()
+            status, key = await http_request(
+                "127.0.0.1", dep.server.cfg.port, "POST", "/PutSet", body,
+                ssl_context=dep.ssl_client, timeout=10.0,
+            )
+            assert status == 200
+            snap.save_all(dep.replicas, cfg.recovery.snapshot_dir)
+        finally:
+            await dep.stop()
+
+        # fresh boot restores the snapshots
+        dep2 = await launch(cfg)
+        try:
+            stored = [
+                r for r in dep2.replicas.values() if r.repository
+            ]
+            assert stored, "no replica restored its snapshot"
+        finally:
+            await dep2.stop()
+
+    asyncio.run(go())
