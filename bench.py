@@ -44,7 +44,9 @@ def bench(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
     n2 = pk.nsquare
 
     cpu = CpuBackend()
-    tpu = TpuBackend()
+    # min_device_batch=0: the verify gate below folds 64 real ciphertexts
+    # and must exercise the DEVICE path, not the adaptive host fallback
+    tpu = TpuBackend(min_device_batch=0)
 
     if verify:
         # correctness gate on REAL ciphertexts: encrypt, fold, decrypt

@@ -1,0 +1,13 @@
+"""BASELINE.md benchmark suite (configs 2-5).
+
+`bench.py` at the repo root is config #1 (the north-star encrypted SUM);
+this package holds the remaining BASELINE.json configs:
+
+- sweep.py    (#2) Paillier key-size sweep 2048/3072/4096: batched SUM + scalar-MUL
+- product.py  (#3) multiplicative-HE (RSA) PRODUCT aggregate
+- bft_sum.py  (#4) 4-replica BFT f=1 end-to-end encrypted SUM through the proxy
+- mixed.py    (#5) OPE range + Paillier SUM mixed YCSB-style workload
+
+Run all:  python -m benchmarks.run_all
+Each module emits one JSON line per measurement (same shape as bench.py).
+"""

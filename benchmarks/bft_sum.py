@@ -1,0 +1,146 @@
+"""BASELINE config #4: 4-replica BFT (f=1) end-to-end encrypted SUM.
+
+Boots the full stack — 4 BFT-ABD replicas (quorum 3 = 2f+1), supervisor,
+REST proxy — loads K Paillier-2048 rows through `PutSet` (client-side
+encryption, HMAC'd quorum writes), then times `SumAll` requests: each one
+re-reads every stored set through full ABD quorums (as the reference does,
+`dds/http/DDSRestServer.scala:397-446`) and folds the PSSE column
+homomorphically on the configured crypto backend. The decrypted result is
+checked against the plaintext total before timing.
+
+Rows are encrypted once up front and shared by both backend runs (the
+client-side Paillier encrypt is not what this config measures). Default
+K=2048 exceeds the tpu backend's adaptive min_device_batch so the fold
+runs on-device end-to-end.
+
+Reported value = homomorphic adds/sec sustained end-to-end
+((K-1) x SumAll requests/sec); vs_baseline = tpu/cpu on this host.
+
+Usage: python -m benchmarks.bft_sum [--k 2048] [--requests 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+from benchmarks.common import emit
+
+PSSE_POS = 2  # canonical schema column 2 is PSSE (client.conf:50-61)
+
+
+async def _bench_backend(backend: str, enc_rows: list, total: int, requests: int,
+                         provider) -> dict:
+    from dds_tpu.http.miniserver import http_request
+    from dds_tpu.run import launch
+    from dds_tpu.utils.config import DDSConfig
+
+    cfg = DDSConfig()
+    cfg.replicas.endpoints = [f"replica-{i}" for i in range(4)]
+    cfg.replicas.sentinent = []
+    cfg.replicas.byz_quorum_size = 3   # 2f+1, f=1
+    cfg.replicas.byz_max_faults = 1
+    cfg.recovery.enabled = False       # no spares in this topology; keep timing clean
+    cfg.proxy.port = 0
+    cfg.proxy.crypto_backend = backend
+
+    dep = await launch(cfg)
+    try:
+        host, port = cfg.proxy.host, dep.server.cfg.port
+        pk = provider.keys.psse.public
+        K = len(enc_rows)
+
+        # ---- load phase: K PutSets through real ABD quorum writes -------
+        t0 = time.perf_counter()
+        bodies = [json.dumps({"contents": enc}).encode() for enc in enc_rows]
+        sem = asyncio.Semaphore(64)  # bound concurrent sockets during load
+
+        async def put(b):
+            async with sem:
+                return await http_request(host, port, "POST", "/PutSet", b)
+
+        statuses = await asyncio.gather(*(put(b) for b in bodies))
+        assert all(s == 200 for s, _ in statuses), "PutSet failures during load"
+        put_s = time.perf_counter() - t0
+
+        # ---- verify: SumAll decrypts to the plaintext total -------------
+        target = f"/SumAll?position={PSSE_POS}&nsqr={pk.nsquare}"
+        status, body = await http_request(host, port, "GET", target, timeout=120.0)
+        assert status == 200, f"SumAll failed: {status}"
+        got = provider.keys.psse.decrypt(int(json.loads(body)["result"]))
+        assert got == total, f"SumAll decrypts wrong: {got} != {total}"
+
+        # ---- timing phase ----------------------------------------------
+        times = []
+        for _ in range(requests):
+            t0 = time.perf_counter()
+            status, _ = await http_request(host, port, "GET", target, timeout=120.0)
+            times.append(time.perf_counter() - t0)
+            assert status == 200
+        best = min(times)
+        return {
+            "backend": backend,
+            "adds_per_sec": (K - 1) / best,
+            "sumall_ms": best * 1e3,
+            "putset_ops_per_sec": K / put_s,
+        }
+    finally:
+        await dep.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=2048, help="stored sets")
+    ap.add_argument("--requests", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    from dds_tpu.bench_key import bench_paillier_key
+    from dds_tpu.models.facade import HomoProvider
+    from dds_tpu.models.keys import HEKeys
+    from dds_tpu.utils.config import DataTableConfig
+
+    keys = HEKeys.generate(paillier_bits=512, rsa_bits=1024)  # psse replaced below
+    keys = HEKeys(
+        ope=keys.ope, che=keys.che, lse=keys.lse,
+        psse=bench_paillier_key(), mse=keys.mse, none=keys.none,
+    )
+    provider = HomoProvider(keys)
+    dt = DataTableConfig()
+
+    vals = list(range(1, args.k + 1))
+    enc_rows = [
+        provider.encrypt_row(
+            [i, f"name-{i}", v, 2, "a", "b", "c", "blob"],
+            dt.fixed_nr_of_columns,
+            dt.fixed_columns_hcrypt,
+        )
+        for i, v in enumerate(vals)
+    ]
+
+    async def go():
+        cpu = await _bench_backend("cpu", enc_rows, sum(vals), args.requests, provider)
+        tpu = await _bench_backend("tpu", enc_rows, sum(vals), args.requests, provider)
+        return cpu, tpu
+
+    cpu, tpu = asyncio.run(go())
+    return [
+        emit(
+            "end-to-end encrypted SUM adds/sec @ Paillier-2048, 4-replica BFT f=1",
+            tpu["adds_per_sec"],
+            "ops/s",
+            tpu["adds_per_sec"] / cpu["adds_per_sec"],
+            K=args.k,
+            quorum=3,
+            fold_path="device" if args.k >= 1024 else
+            "host (adaptive: K < min_device_batch=1024)",
+            tpu_sumall_ms=round(tpu["sumall_ms"], 2),
+            cpu_sumall_ms=round(cpu["sumall_ms"], 2),
+            putset_ops_per_sec=round(tpu["putset_ops_per_sec"], 1),
+        )
+    ]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Shared timing helpers for the benchmark suite."""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def best_of(fn, repeats: int = 3) -> float:
+    """Min wall-clock seconds over `repeats` timed calls. All calls are
+    timed — callers must warm/compile with an explicit untimed call first."""
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float, **detail) -> dict:
+    row = {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(vs_baseline, 3),
+    }
+    if detail:
+        row["detail"] = detail
+    print(json.dumps(row))
+    return row

@@ -1,0 +1,102 @@
+"""BASELINE config #5: OPE range query + Paillier SUM mixed workload.
+
+YCSB-style mix through the full stack (client-side HE, REST proxy, ABD
+quorums over the default 9-replica/quorum-5 topology): 20% PutSet, 40% OPE
+range searches (Gt/GtEq/Lt/LtEq on the OPE column), 20% SumAll, 10% GetSet,
+10% equality search — driven by the schema-aware workload generator, the
+same operational-test mechanism the reference uses (SURVEY.md §4.1).
+
+Reports end-to-end client ops/s per crypto backend.
+
+Usage: python -m benchmarks.mixed [--ops 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from benchmarks.common import emit
+
+MIX = {
+    "put-set": 0.2,
+    "search-gt": 0.1, "search-gteq": 0.1, "search-lt": 0.1, "search-lteq": 0.1,
+    "sum-all": 0.2,
+    "get-set": 0.1,
+    "search-eq": 0.1,
+}
+
+
+async def _run_backend(backend: str, ops: int, provider, seed: int,
+                       force_device: bool) -> tuple[float, int]:
+    from dds_tpu.run import launch, run_workload
+    from dds_tpu.utils.config import DDSConfig
+
+    cfg = DDSConfig()
+    cfg.proxy.port = 0
+    cfg.proxy.crypto_backend = backend
+    cfg.recovery.enabled = False       # keep timing clean of proactive restarts
+    cfg.client.nr_of_operations = ops
+    cfg.client.proportions = dict(MIX)
+
+    dep = await launch(cfg)
+    if force_device and hasattr(dep.server.backend, "min_device_batch"):
+        dep.server.backend.min_device_batch = 0
+    try:
+        reports = await run_workload(dep, provider=provider, seed=seed)
+        r = reports[0]
+        assert r.failed == 0, f"{r.failed} ops failed on {backend}"
+        return r.ops_per_second, len(dep.server.stored_keys)
+    finally:
+        await dep.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument(
+        "--force-device", action="store_true",
+        help="set the tpu backend's min_device_batch to 0 so every SumAll "
+        "fold runs on-device; default keeps the production adaptive "
+        "dispatch, which at this workload's stored-set count (< the 1024 "
+        "threshold) routes folds to the host path",
+    )
+    args = ap.parse_args(argv)
+
+    from dds_tpu.bench_key import bench_paillier_key
+    from dds_tpu.models.facade import HomoProvider
+    from dds_tpu.models.keys import HEKeys
+
+    keys = HEKeys.generate(paillier_bits=512, rsa_bits=1024)  # psse replaced below
+    keys = HEKeys(
+        ope=keys.ope, che=keys.che, lse=keys.lse,
+        psse=bench_paillier_key(), mse=keys.mse, none=keys.none,
+    )
+    provider = HomoProvider(keys)
+
+    async def go():
+        cpu = await _run_backend("cpu", args.ops, provider, args.seed, False)
+        tpu = await _run_backend("tpu", args.ops, provider, args.seed,
+                                 args.force_device)
+        return cpu, tpu
+
+    (cpu_ops, _), (tpu_ops, stored) = asyncio.run(go())
+    return [
+        emit(
+            "mixed OPE-range + Paillier-SUM workload ops/sec (9 replicas, q=5)",
+            tpu_ops,
+            "ops/s",
+            tpu_ops / cpu_ops,
+            ops=args.ops,
+            mix=MIX,
+            cpu_ops_per_sec=round(cpu_ops, 1),
+            stored_sets=stored,
+            fold_path="device (forced)" if args.force_device else
+            "adaptive (host below min_device_batch=1024)",
+        )
+    ]
+
+
+if __name__ == "__main__":
+    main()

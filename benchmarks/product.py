@@ -1,0 +1,81 @@
+"""BASELINE config #3: multiplicative-HE PRODUCT aggregate.
+
+The proxy's `MultAll` route folds RSA-multiplicative ciphertexts with
+`HomoMult.multiply` (`dds/http/DDSRestServer.scala:505-524`): a modmul
+fold mod n. Times that fold cpu vs tpu (one fused Montgomery tree
+reduction over device-resident limbs), decrypt-verified first.
+
+The reference ships an RSA-1024 multiplicative key (`client.conf:86`);
+we sweep 1024 and 2048.
+
+Usage: python -m benchmarks.product [--k 16384] [--sizes 1024,2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import secrets
+
+import numpy as np
+
+from benchmarks.common import best_of, emit
+
+
+def product_one(bits: int, K: int, repeats: int = 3) -> dict:
+    import jax
+
+    from dds_tpu.models.backend import CpuBackend, TpuBackend
+    from dds_tpu.models.mult import RsaMultKey
+    from dds_tpu.ops import bignum as bn
+    from dds_tpu.ops.montgomery import ModCtx
+
+    key = RsaMultKey.generate(bits)
+    pk = key.public
+    # min_device_batch=0: the correctness gate must exercise the device fold
+    cpu, tpu = CpuBackend(), TpuBackend(min_device_batch=0)
+
+    # correctness gate: PRODUCT of real ciphertexts decrypts to the product
+    vals = [secrets.randbelow(1 << 16) + 1 for _ in range(8)]
+    cts = [pk.encrypt(v) for v in vals]
+    want = 1
+    for v in vals:
+        want = want * v % pk.n
+    assert key.decrypt(tpu.modmul_fold(cts, pk.n)) == want
+
+    cs = [secrets.randbelow(pk.n) for _ in range(K)]
+    cpu_s = best_of(lambda: cpu.modmul_fold(cs, pk.n), repeats)
+    cpu_ops = (K - 1) / cpu_s
+
+    ctx = ModCtx.make(pk.n)
+    resident = jax.device_put(bn.ints_to_batch(cs, ctx.L))
+    jax.block_until_ready(resident)
+    fold = lambda: np.asarray(tpu.reduce_mul_device(ctx, resident))
+    fold()  # warm/compile
+    tpu_s = best_of(fold, repeats)
+    tpu_ops = (K - 1) / tpu_s
+    return emit(
+        f"encrypted PRODUCT ops/sec @ RSA-{bits} (MultAll fold)",
+        tpu_ops,
+        "ops/s",
+        tpu_ops / cpu_ops,
+        K=K,
+        limbs=ctx.L,
+        cpu_ops_per_sec=round(cpu_ops, 1),
+        tpu_fold_ms=round(tpu_s * 1e3, 2),
+        cpu_fold_ms=round(cpu_s * 1e3, 2),
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=16384)
+    ap.add_argument("--sizes", default="1024,2048")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args(argv)
+    return [
+        product_one(int(s), args.k, args.repeats) for s in args.sizes.split(",")
+    ]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Run every BASELINE.md benchmark config and collect the JSON lines.
+
+    python -m benchmarks.run_all [--quick]
+
+`--quick` shrinks batch sizes for a fast smoke pass (CI / CPU-only hosts).
+Results also land in benchmarks/results.json for BASELINE.md bookkeeping.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+
+    from benchmarks import bft_sum, mixed, product, sweep
+
+    rows = []
+    if args.quick:
+        rows += sweep.main(["--k", "1024", "--b", "32", "--sizes", "2048"])
+        rows += product.main(["--k", "1024", "--sizes", "1024"])
+        rows += bft_sum.main(["--k", "32", "--requests", "2"])
+        rows += mixed.main(["--ops", "60"])
+    else:
+        rows += sweep.main([])
+        rows += product.main([])
+        rows += bft_sum.main([])
+        rows += mixed.main([])
+
+    # quick mode is a smoke pass: never clobber real baseline results
+    name = "results_quick.json" if args.quick else "results.json"
+    out = pathlib.Path(__file__).with_name(name)
+    out.write_text(json.dumps(rows, indent=2) + "\n")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -78,9 +78,27 @@ class TpuBackend:
 
     name = "tpu"
 
-    def __init__(self, pallas: bool | None = None):
+    def __init__(self, pallas: bool | None = None, min_device_batch: int | None = None):
+        import os
+
         self.pallas = _use_pallas() if pallas is None else pallas
+        # Adaptive dispatch: below this fold width the flat device-dispatch
+        # latency loses to a host fold, so small aggregates stay on host
+        # (measured crossover ~1024 on tunneled v5e; DDS_TPU_MIN_BATCH
+        # overrides, 0 forces everything onto the device).
+        self.min_device_batch = (
+            int(os.environ.get("DDS_TPU_MIN_BATCH", "1024"))
+            if min_device_batch is None
+            else min_device_batch
+        )
         self._stores: dict[int, object] = {}
+
+    @staticmethod
+    def _host_fold(cs: list[int], modulus: int) -> int:
+        # native.fold's contract: never fails (python-int fallback inside)
+        from dds_tpu import native
+
+        return native.fold(cs, modulus)
 
     def store_for(self, modulus: int):
         """Per-modulus device-resident cipher store (ops/store.py)."""
@@ -97,11 +115,16 @@ class TpuBackend:
 
     def modmul_fold_resident(self, cs: list[int], modulus: int) -> int:
         """Fold via the device store: unseen ciphertexts ingest once, the
-        aggregate gathers resident rows on-device."""
+        aggregate gathers resident rows on-device. Folds narrower than
+        min_device_batch run on host (the store is not consulted: a later
+        wide aggregate pays ingest for those rows then)."""
+        if len(cs) < self.min_device_batch:
+            return self._host_fold(cs, modulus)
         return self.store_for(modulus).fold(cs)
 
     def modmul(self, c1: int, c2: int, modulus: int) -> int:
-        return self.modmul_fold([c1, c2], modulus)
+        # one multiply: a device round-trip can never win
+        return c1 * c2 % modulus
 
     def reduce_mul_device(self, ctx: ModCtx, batch):
         """Modular product over an already-resident (K, L) limb batch.
@@ -115,6 +138,8 @@ class TpuBackend:
         return ctx.reduce_mul(batch)
 
     def modmul_fold(self, cs: list[int], modulus: int) -> int:
+        if len(cs) < self.min_device_batch:
+            return self._host_fold(cs, modulus)
         ctx = ModCtx.make(modulus)
         batch = bn.ints_to_batch(cs, ctx.L)
         out = self.reduce_mul_device(ctx, batch)

@@ -105,9 +105,18 @@ def test_unknown_scheme_tag():
         PROVIDER.encrypt(1, "XYZ")
 
 
+def _backend(name):
+    """tpu tests must exercise the DEVICE fold even on small batches, not
+    the adaptive host fallback (min_device_batch defaults to 1024)."""
+    be = get_backend(name)
+    if name == "tpu":
+        be.min_device_batch = 0
+    return be
+
+
 @pytest.mark.parametrize("backend_name", ["cpu", "tpu"])
 def test_backend_paillier_sum(backend_name):
-    be = get_backend(backend_name)
+    be = _backend(backend_name)
     pk = KEYS.psse.public
     vals = [rng.randrange(1 << 20) for _ in range(9)]
     cs = [pk.encrypt(v) for v in vals]
@@ -119,7 +128,7 @@ def test_backend_paillier_sum(backend_name):
 
 @pytest.mark.parametrize("backend_name", ["cpu", "tpu"])
 def test_backend_rsa_product(backend_name):
-    be = get_backend(backend_name)
+    be = _backend(backend_name)
     k = KEYS.mse
     vals = [rng.randrange(1 << 8) for _ in range(5)]
     cs = [k.public.encrypt(v) for v in vals]

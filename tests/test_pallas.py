@@ -67,7 +67,9 @@ def test_backend_pallas_fold_matches_cpu(ctx):
     rng = random.Random(7)
     n = ctx.n
     cs = [rng.randrange(1, n) for _ in range(9)]
-    tpu = TpuBackend(pallas=True)
+    # min_device_batch=0: a 9-element fold must hit the Pallas kernel, not
+    # the adaptive host fallback
+    tpu = TpuBackend(pallas=True, min_device_batch=0)
     cpu = CpuBackend()
     assert tpu.modmul_fold(cs, n) == cpu.modmul_fold(cs, n)
     assert tpu.powmod_batch(cs[:2], 65537, n) == cpu.powmod_batch(cs[:2], 65537, n)

@@ -72,9 +72,29 @@ def test_backend_resident_fold(modulus):
 
     rng = random.Random(4)
     cs = [rng.randrange(1, modulus) for _ in range(7)]
-    tpu = TpuBackend()
+    tpu = TpuBackend(min_device_batch=0)  # force the resident/device path
     cpu = CpuBackend()
     assert tpu.modmul_fold_resident(cs, modulus) == cpu.modmul_fold(cs, modulus)
     # second call hits the same store instance
     assert tpu.store_for(modulus).resident == 7
     assert tpu.modmul_fold_resident(cs, modulus) == cpu.modmul_fold(cs, modulus)
+
+
+def test_backend_adaptive_dispatch(modulus):
+    """Folds narrower than min_device_batch take the host path (same
+    result), pair modmul is always host math, and the device store is not
+    populated by host-dispatched folds."""
+    from dds_tpu.models.backend import CpuBackend, TpuBackend
+
+    rng = random.Random(5)
+    cs = [rng.randrange(1, modulus) for _ in range(9)]
+    cpu = CpuBackend()
+    tpu = TpuBackend(min_device_batch=64)
+    assert tpu.modmul_fold(cs, modulus) == cpu.modmul_fold(cs, modulus)
+    assert tpu.modmul_fold_resident(cs, modulus) == cpu.modmul_fold(cs, modulus)
+    assert tpu.store_for(modulus).resident == 0
+    assert tpu.modmul(3, 5, modulus) == 15 % modulus
+    # at threshold 0 the same inputs go through the device store
+    forced = TpuBackend(min_device_batch=0)
+    assert forced.modmul_fold_resident(cs, modulus) == cpu.modmul_fold(cs, modulus)
+    assert forced.store_for(modulus).resident == len(set(cs))
