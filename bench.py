@@ -78,15 +78,25 @@ def bench(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
     jax.block_until_ready(resident)
     ingest_s = time.perf_counter() - t0
 
-    fold = lambda: np.asarray(tpu.reduce_mul_device(ctx, resident))
+    # TPU sustained throughput: benchmarks.common.sustained_device
+    # pipelines R fold dispatches on the device stream and fetches ONE
+    # device-side combine. A serving proxy overlaps aggregate dispatches
+    # exactly like this; timing each fold with a blocking fetch would
+    # measure the host<->device link's round-trip latency (~67 ms on
+    # tunneled platforms), not the kernel. Per-fold latency (1 dispatch +
+    # 1 blocking fetch) is reported in `detail`.
+    from benchmarks.common import sustained_device
 
-    fold()  # warm/compile
-    t_tpu = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        fold()
-        t_tpu.append(time.perf_counter() - t0)
-    tpu_ops = (K - 1) / min(t_tpu)
+    R = 16
+    np.asarray(tpu.reduce_mul_device(ctx, resident))  # warm/compile fold
+    fold_s = sustained_device(
+        lambda: tpu.reduce_mul_device(ctx, resident), R=R, repeats=repeats
+    )
+    tpu_ops = (K - 1) / fold_s
+
+    t0 = time.perf_counter()
+    np.asarray(tpu.reduce_mul_device(ctx, resident))
+    lat_ms = (time.perf_counter() - t0) * 1e3
 
     return {
         "metric": "encrypted SUM ops/sec @ Paillier-2048 (batched homomorphic add)",
@@ -97,7 +107,9 @@ def bench(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
             "K": K,
             "kernel": "pallas" if tpu.pallas else "jnp",
             "cpu_ops_per_sec": round(cpu_ops, 1),
-            "tpu_fold_ms": round(min(t_tpu) * 1e3, 2),
+            "tpu_fold_ms_sustained": round(fold_s * 1e3, 2),
+            "tpu_fold_ms_single_dispatch": round(lat_ms, 2),
+            "pipelined_folds": R,
             "cpu_fold_ms": round(min(t_cpu) * 1e3, 2),
             "ingest_ms_one_time": round(ingest_s * 1e3, 2),
         },

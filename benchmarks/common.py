@@ -17,6 +17,33 @@ def best_of(fn, repeats: int = 3) -> float:
     return min(times)
 
 
+def sustained_device(dispatch, R: int = 16, repeats: int = 3) -> float:
+    """Sustained per-dispatch seconds for a device computation.
+
+    `dispatch()` must enqueue work and return a jax array WITHOUT fetching.
+    Pipelines R dispatches on the device stream and fetches ONE device-side
+    scalar combine, so the host<->device round-trip (~tens of ms on
+    tunneled platforms) is paid once per R dispatches — matching how a
+    serving proxy overlaps aggregate dispatches. A blocking fetch per
+    dispatch would time the link latency, not the kernels.
+    """
+    import jax
+    import numpy as np
+
+    combine = jax.jit(lambda xs: sum(x.sum() for x in xs))
+
+    def run():
+        return np.asarray(combine([dispatch() for _ in range(R)]))
+
+    run()  # warm/compile
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()
+        ts.append(time.perf_counter() - t0)
+    return min(ts) / R
+
+
 def emit(metric: str, value: float, unit: str, vs_baseline: float, **detail) -> dict:
     row = {
         "metric": metric,

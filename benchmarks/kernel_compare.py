@@ -1,0 +1,72 @@
+"""Compare fold kernels v1 (fused CIOS) vs v2 (VPU product + MXU REDC).
+
+Correctness-gates v2 against python ints on real device values first,
+then times both with the sustained pipelined methodology.
+
+Usage: python -m benchmarks.kernel_compare [--k 65536] [--bits 2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import secrets
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=65536)
+    ap.add_argument("--bits", type=int, default=2048)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from benchmarks.common import emit, sustained_device
+    from dds_tpu.bench_key import bench_paillier_key
+    from dds_tpu.ops import bignum as bn
+    from dds_tpu.ops import mont_mxu as mx
+    from dds_tpu.ops import pallas_mont as pm
+    from dds_tpu.ops.montgomery import ModCtx
+
+    key = bench_paillier_key(args.bits)
+    n2 = key.public.nsquare
+    ctx = ModCtx.make(n2)
+    mctx = mx.MxuCtx.make(ctx)
+
+    # correctness gate on-device: both kernels agree with python ints
+    small = [secrets.randbelow(n2) for _ in range(16)]
+    want = 1
+    for c in small:
+        want = want * c % n2
+    sb = bn.ints_to_batch(small, ctx.L)
+    got1 = bn.batch_to_ints(np.asarray(pm.reduce_mul(ctx, sb)))[0]
+    got2 = bn.batch_to_ints(np.asarray(mx.reduce_mul2(mctx, sb)))[0]
+    assert got1 == want, "v1 fold wrong on device"
+    assert got2 == want, "v2 fold wrong on device"
+
+    cs = [secrets.randbelow(n2) for _ in range(args.k)]
+    resident = jax.device_put(bn.ints_to_batch(cs, ctx.L))
+    jax.block_until_ready(resident)
+
+    rows = []
+    t1 = sustained_device(lambda: pm.reduce_mul(ctx, resident), repeats=args.repeats)
+    t2 = sustained_device(lambda: mx.reduce_mul2(mctx, resident), repeats=args.repeats)
+    for name, t in (("v1-cios", t1), ("v2-mxu", t2)):
+        rows.append(
+            emit(
+                f"fold kernel {name} @ {args.bits}-bit Paillier (mod n^2)",
+                (args.k - 1) / t,
+                "ops/s",
+                t1 / t,
+                K=args.k,
+                limbs=ctx.L,
+                fold_ms=round(t * 1e3, 3),
+                ns_per_modmul=round(t / args.k * 1e9, 1),
+            )
+        )
+    return rows
+
+
+if __name__ == "__main__":
+    main()

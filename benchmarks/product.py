@@ -16,9 +16,8 @@ from __future__ import annotations
 import argparse
 import secrets
 
-import numpy as np
 
-from benchmarks.common import best_of, emit
+from benchmarks.common import best_of, emit, sustained_device
 
 
 def product_one(bits: int, K: int, repeats: int = 3) -> dict:
@@ -49,9 +48,9 @@ def product_one(bits: int, K: int, repeats: int = 3) -> dict:
     ctx = ModCtx.make(pk.n)
     resident = jax.device_put(bn.ints_to_batch(cs, ctx.L))
     jax.block_until_ready(resident)
-    fold = lambda: np.asarray(tpu.reduce_mul_device(ctx, resident))
-    fold()  # warm/compile
-    tpu_s = best_of(fold, repeats)
+    tpu_s = sustained_device(
+        lambda: tpu.reduce_mul_device(ctx, resident), repeats=repeats
+    )
     tpu_ops = (K - 1) / tpu_s
     return emit(
         f"encrypted PRODUCT ops/sec @ RSA-{bits} (MultAll fold)",

@@ -1,0 +1,134 @@
+"""Microprofile of the Pallas CIOS building blocks (dev tool, not a config).
+
+All timed functions return a scalar reduction of their output so only 4
+bytes cross the (slow, tunneled) host<->device link per call while the full
+computation still runs (a slice would let XLA dead-code-eliminate the rest).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dds_tpu.bench_key import bench_paillier_key
+from dds_tpu.ops import pallas_mont as pm
+from dds_tpu.ops.montgomery import ModCtx
+
+
+def timeit(fn, *args, repeats=5):
+    np.asarray(fn(*args))  # warm/compile
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def make_nofinal_mul(L, Lt, TB):
+    """Same CIOS loop, but skip finalize: emit redundant t rows directly."""
+
+    def kernel(n0_ref, a_ref, b_ref, nbx_ref, out_ref):
+        n0 = n0_ref[0, 0]
+        b = b_ref[:, :]
+        nb = nbx_ref[0:L, :]
+        t = pm._cios_loop(
+            lambda i: a_ref[pl.ds(i, 1), :], b, nb, n0,
+            jnp.zeros((Lt, TB), jnp.uint32), L,
+        )
+        out_ref[:, :] = t[0:L, :]
+
+    def call(B):
+        return pl.pallas_call(
+            kernel,
+            grid=(B // TB,),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((L, TB), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((L, TB), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((Lt, TB), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((L, TB), lambda i: (0, i), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((L, B), jnp.uint32),
+            interpret=pm._interpret_default(),
+        )
+
+    return call
+
+
+def main():
+    key = bench_paillier_key()
+    ctx = ModCtx.make(key.nsquare)
+    L, TB = ctx.L, pm.MUL_TB
+    Lt = pm._pad_rows(L)
+    B = 8192
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(0, 1 << 16, size=(L, B), dtype=np.uint32))
+    b = jnp.asarray(rng.integers(0, 1 << 16, size=(L, B), dtype=np.uint32))
+
+    f = jax.jit(lambda a, b: pm.mul_lm(ctx, a, b).sum())
+    t_full = timeit(f, a, b)
+    print(f"mul_lm       B={B}: {t_full*1e3:8.2f} ms  -> {t_full/B*1e9:7.1f} ns/modmul")
+
+    nf = make_nofinal_mul(L, Lt, TB)(B)
+    g = jax.jit(lambda a, b: nf(pm._n0(ctx), a, b, pm._nbx(ctx, TB)).sum())
+    t_nf = timeit(g, a, b)
+    print(f"no-finalize  B={B}: {t_nf*1e3:8.2f} ms  -> {t_nf/B*1e9:7.1f} ns/modmul")
+    print(f"finalize share: {(t_full-t_nf)/t_full*100:.1f}%")
+
+    # VPU elementwise throughput probes (32 chained ops on a 32M tile)
+    x = jnp.asarray(rng.integers(0, 1 << 16, size=(512, 65536), dtype=np.uint32))
+
+    @jax.jit
+    def muls(x):
+        y = x
+        for _ in range(32):
+            y = (y * x) & np.uint32(0xFFFF)
+        return y.sum()
+
+    t_m = timeit(muls, x)
+    print(f"u32 mul+mask chain: {64 * x.size / t_m / 1e12:.2f} T elem-ops/s")
+
+    @jax.jit
+    def adds(x):
+        y = x
+        for _ in range(32):
+            y = y + x
+        return y.sum()
+
+    t_a = timeit(adds, x)
+    print(f"u32 add chain:      {32 * x.size / t_a / 1e12:.2f} T elem-ops/s")
+
+    # MXU probes at the Montgomery-reduction shape (XLA level)
+    L8 = 2 * L
+    Bm = 4096
+    Mi = jnp.asarray(rng.integers(-128, 127, size=(2 * L8, L8), dtype=np.int8))
+    Vi = jnp.asarray(rng.integers(-128, 127, size=(L8, Bm), dtype=np.int8))
+
+    @jax.jit
+    def mm_i8(M, V):
+        return jax.lax.dot(M, V, preferred_element_type=jnp.int32).sum()
+
+    t_mm = timeit(mm_i8, Mi, Vi)
+    macs = 2 * L8 * L8 * Bm
+    print(f"int8 matmul ({2*L8}x{L8})@({L8}x{Bm}): {t_mm*1e3:.2f} ms  "
+          f"{macs/t_mm/1e12:.1f} T MAC/s")
+
+    Mf = jnp.asarray(rng.integers(0, 128, size=(2 * L8, L8)).astype(np.float32))
+    Vf = jnp.asarray(rng.integers(0, 128, size=(L8, Bm)).astype(np.float32))
+
+    @jax.jit
+    def mm_f32(M, V):
+        return jax.lax.dot(M, V, preferred_element_type=jnp.float32).sum()
+
+    t_mf = timeit(mm_f32, Mf, Vf)
+    print(f"f32 matmul  same shape: {t_mf*1e3:.2f} ms  {macs/t_mf/1e12:.1f} T MAC/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,9 +20,8 @@ from __future__ import annotations
 import argparse
 import secrets
 
-import numpy as np
 
-from benchmarks.common import best_of, emit
+from benchmarks.common import best_of, emit, sustained_device
 
 SCALAR_BITS = 64
 
@@ -61,9 +60,9 @@ def sweep_one(bits: int, K: int, B: int, repeats: int = 3) -> list[dict]:
     ctx = ModCtx.make(n2)
     resident = jax.device_put(bn.ints_to_batch(cs, ctx.L))
     jax.block_until_ready(resident)
-    fold = lambda: np.asarray(tpu.reduce_mul_device(ctx, resident))
-    fold()  # warm/compile
-    tpu_s = best_of(fold, repeats)
+    tpu_s = sustained_device(
+        lambda: tpu.reduce_mul_device(ctx, resident), repeats=repeats
+    )
     tpu_ops = (K - 1) / tpu_s
     rows.append(
         emit(
@@ -89,11 +88,10 @@ def sweep_one(bits: int, K: int, B: int, repeats: int = 3) -> list[dict]:
     if tpu.pallas:
         from dds_tpu.ops import pallas_mont
 
-        run = lambda: np.asarray(pallas_mont.pow_mod(ctx, batch, k_scalar))
+        run = lambda: pallas_mont.pow_mod(ctx, batch, k_scalar)
     else:
-        run = lambda: np.asarray(ctx.pow_mod(batch, k_scalar))
-    run()  # warm/compile
-    tpu_s = best_of(run, repeats)
+        run = lambda: ctx.pow_mod(batch, k_scalar)
+    tpu_s = sustained_device(run, R=8, repeats=repeats)
     tpu_ops = B / tpu_s
     rows.append(
         emit(

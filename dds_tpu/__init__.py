@@ -21,3 +21,28 @@ asyncio + HMAC-framed transports.
 """
 
 __version__ = "0.1.0"
+
+
+def _setup_jax_compilation_cache() -> None:
+    """Enable JAX's persistent compilation cache for the whole framework.
+
+    The tier-0 kernels compile one executable per (modulus limb count,
+    batch shape); a cold proxy/client process otherwise recompiles every
+    shape (~20-40 s each on tunneled TPU platforms). Set via environment
+    variables (read by jax at ITS import — no jax import cost here for
+    host-only consumers). Opt out with DDS_JAX_CACHE=off; point elsewhere
+    with DDS_JAX_CACHE=/path.
+    """
+    import os
+
+    val = os.environ.get("DDS_JAX_CACHE", "")
+    if val.strip().lower() in ("0", "off", "false", "no"):
+        return
+    path = val or os.path.join(
+        os.path.expanduser("~"), ".cache", "dds_tpu_jax"
+    )
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", path)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+
+
+_setup_jax_compilation_cache()

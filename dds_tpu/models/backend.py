@@ -78,10 +78,22 @@ class TpuBackend:
 
     name = "tpu"
 
-    def __init__(self, pallas: bool | None = None, min_device_batch: int | None = None):
+    def __init__(self, pallas: bool | None = None, min_device_batch: int | None = None,
+                 kernel: str | None = None):
         import os
 
         self.pallas = _use_pallas() if pallas is None else pallas
+        # Fold kernel: "v2" = schoolbook product + MXU band-matmul REDC
+        # (ops/mont_mxu), "v1" = fused CIOS (ops/pallas_mont). v2 wins on
+        # TPU hardware (see benchmarks/kernel_compare.py); DDS_KERNEL
+        # overrides.
+        self.kernel = (
+            kernel if kernel is not None else os.environ.get("DDS_KERNEL", "v2")
+        ).strip().lower()
+        if self.kernel not in ("v1", "v2"):
+            raise ValueError(
+                f"unknown fold kernel {self.kernel!r} (must be v1 or v2)"
+            )
         # Adaptive dispatch: below this fold width the flat device-dispatch
         # latency loses to a host fold, so small aggregates stay on host
         # (measured crossover ~1024 on tunneled v5e; DDS_TPU_MIN_BATCH
@@ -132,6 +144,10 @@ class TpuBackend:
         The device-level fold entry point shared by modmul_fold, the
         proxy's aggregate routes, and bench.py — one dispatch rule."""
         if self.pallas:
+            if self.kernel == "v2":
+                from dds_tpu.ops import mont_mxu
+
+                return mont_mxu.reduce_mul2(mont_mxu.MxuCtx.make(ctx), batch)
             from dds_tpu.ops import pallas_mont
 
             return pallas_mont.reduce_mul(ctx, batch)

@@ -332,6 +332,15 @@ def _reduce_fn(ctx: ModCtx, P2: int, interpret: bool):
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=512)
+def _fold_fix(ctx: ModCtx, K: int):
+    """Device-resident R^K mod n fixup for a K-term fold (cached: the proxy
+    folds the same store size repeatedly, and the host modexp + transfer
+    otherwise costs milliseconds per aggregate on tunneled platforms)."""
+    R = 1 << (LIMB_BITS * ctx.L)
+    return jax.device_put(bn.int_to_limbs(pow(R % ctx.n, K, ctx.n), ctx.L))
+
+
 def reduce_mul(ctx: ModCtx, cs, interpret: bool | None = None):
     """Modular product of all K rows of cs ((K, L) plain domain, K >= 1).
 
@@ -348,9 +357,7 @@ def reduce_mul(ctx: ModCtx, cs, interpret: bool | None = None):
     if P2 != K:
         pad = jnp.broadcast_to(jnp.asarray(ctx.one_mont), (P2 - K, ctx.L))
         cs = jnp.concatenate([cs, pad], axis=0)
-    R = 1 << (LIMB_BITS * ctx.L)
-    fix = bn.int_to_limbs(pow(R % ctx.n, K, ctx.n), ctx.L)
-    return _reduce_fn(ctx, P2, interpret)(cs, jnp.asarray(fix))
+    return _reduce_fn(ctx, P2, interpret)(cs, _fold_fix(ctx, K))
 
 
 @functools.lru_cache(maxsize=None)
