@@ -16,23 +16,36 @@ Measures the proxy-side homomorphic-add fold (the compute inside the
         is reported in `detail`.
 
 Both backends are verified against Paillier decryption before timing.
-Timing forces a host fetch of the result (np.asarray) — on tunneled TPU
-platforms `block_until_ready` can return before execution finishes.
 
-Emits ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-value is the TPU fold's homomorphic adds/sec and vs_baseline is the
-speedup over the CPU backend on this host.
+Driver-proof by construction: the default entry point is a DRIVER that
+never initializes a JAX backend in-process. It probes device health in a
+subprocess (with timeout + retry-with-backoff, because the tunneled TPU
+platform intermittently wedges: `jax.devices()` hangs or raises
+UNAVAILABLE and recovers on its own after a wait), then runs the actual
+measurement in a `--worker` subprocess, and ALWAYS prints exactly one
+JSON line to stdout and exits 0 — on unrecoverable failure the line is
+{"metric": ..., "value": null, "error": ..., ...} with the pure-python
+CPU baseline in `detail` instead of a traceback.
 """
 
 import json
+import os
 import secrets
+import subprocess
+import sys
 import time
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
+METRIC = "encrypted SUM ops/sec @ Paillier-2048 (batched homomorphic add)"
 
+
+# --------------------------------------------------------------------------
+# worker: the real measurement (runs in a subprocess spawned by the driver)
+# --------------------------------------------------------------------------
 
 def bench(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
     import jax
+    import numpy as np
 
     from dds_tpu.bench_key import bench_paillier_key
     from dds_tpu.models.backend import CpuBackend, TpuBackend
@@ -84,7 +97,7 @@ def bench(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
     # exactly like this; timing each fold with a blocking fetch would
     # measure the host<->device link's round-trip latency (~67 ms on
     # tunneled platforms), not the kernel. Per-fold latency (1 dispatch +
-    # 1 blocking fetch) is reported in `detail`.
+    # 1 blocking fetch, min over `repeats`) is reported in `detail`.
     from benchmarks.common import sustained_device
 
     R = 16
@@ -94,21 +107,25 @@ def bench(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
     )
     tpu_ops = (K - 1) / fold_s
 
-    t0 = time.perf_counter()
-    np.asarray(tpu.reduce_mul_device(ctx, resident))
-    lat_ms = (time.perf_counter() - t0) * 1e3
+    lat_ms = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(tpu.reduce_mul_device(ctx, resident))
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
 
     return {
-        "metric": "encrypted SUM ops/sec @ Paillier-2048 (batched homomorphic add)",
+        "metric": METRIC,
         "value": round(tpu_ops, 1),
         "unit": "ops/s",
         "vs_baseline": round(tpu_ops / cpu_ops, 3),
         "detail": {
             "K": K,
             "kernel": "pallas" if tpu.pallas else "jnp",
+            "backend": jax.default_backend(),
+            "sustained": True,
             "cpu_ops_per_sec": round(cpu_ops, 1),
             "tpu_fold_ms_sustained": round(fold_s * 1e3, 2),
-            "tpu_fold_ms_single_dispatch": round(lat_ms, 2),
+            "tpu_fold_ms_single_dispatch": round(min(lat_ms), 2),
             "pipelined_folds": R,
             "cpu_fold_ms": round(min(t_cpu) * 1e3, 2),
             "ingest_ms_one_time": round(ingest_s * 1e3, 2),
@@ -116,6 +133,165 @@ def bench(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
     }
 
 
+# --------------------------------------------------------------------------
+# driver: probe / retry / always emit one JSON line
+# --------------------------------------------------------------------------
+
+def _log(msg: str) -> None:
+    print(f"[bench-driver] {msg}", file=sys.stderr, flush=True)
+
+
+def _run_sub(cmd: list[str], timeout_s: float) -> tuple[int | None, str, str]:
+    """Run a subprocess from the repo root (device init hangs from other
+    cwds on the tunneled platform). Returns (rc, stdout, stderr); rc=None
+    means it hung past the timeout and was killed."""
+    try:
+        p = subprocess.run(
+            cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout_s
+        )
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        return None, out, err
+
+
+def _probe_device(timeout_s: float) -> tuple[bool, str]:
+    rc, out, err = _run_sub(
+        [sys.executable, "-u", "-c", "import jax; print(jax.devices())"],
+        timeout_s,
+    )
+    if rc == 0:
+        last = out.strip().splitlines()[-1] if out.strip() else ""
+        # rc=0 with a CPU-only device list means jax fell back to the CPU
+        # backend (e.g. JAX_PLATFORMS cleared) — that is NOT a healthy TPU:
+        # the worker would bank a CPU number under the TPU metric.
+        if any(tag in last.lower() for tag in ("tpu", "axon")):
+            return True, last
+        return False, f"no TPU device (got {last[:120]!r})"
+    reason = "hang/timeout" if rc is None else f"rc={rc}"
+    tail = (err or out).strip().splitlines()[-1:] or [""]
+    return False, f"{reason}: {tail[0][:200]}"
+
+
+def _probe_loop(deadline_s: float, probe_timeout_s: float, sleep_s: float) -> bool:
+    """Retry the device probe until it succeeds or the deadline passes.
+    The tunnel's wedge clears on its own — waiting is the fix."""
+    t_end = time.monotonic() + deadline_s
+    attempt = 0
+    while True:
+        attempt += 1
+        ok, info = _probe_device(probe_timeout_s)
+        _log(f"probe #{attempt}: {'OK ' + info if ok else 'FAIL ' + info}")
+        if ok:
+            return True
+        remaining = t_end - time.monotonic()
+        if remaining <= 0:
+            return False
+        time.sleep(min(sleep_s, max(remaining, 1.0)))
+
+
+def _parse_worker_json(out: str) -> dict | None:
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict) and row.get("metric"):
+            return row
+    return None
+
+
+def _cpu_fallback_detail(K: int = 65536) -> dict:
+    """Pure-python CPU baseline (no jax import, cannot hang): the number
+    the TPU result would have been compared against."""
+    from dds_tpu.bench_key import bench_paillier_key
+
+    n2 = bench_paillier_key().public.nsquare
+    cs = [secrets.randbelow(n2) for _ in range(K)]
+    t_best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        acc = 1
+        for c in cs:
+            acc = acc * c % n2
+        dt = time.perf_counter() - t0
+        t_best = dt if t_best is None else min(t_best, dt)
+    return {
+        "K": K,
+        "cpu_ops_per_sec": round((K - 1) / t_best, 1),
+        "cpu_fold_ms": round(t_best * 1e3, 2),
+    }
+
+
+def _driver() -> dict:
+    probe_deadline = float(os.environ.get("DDS_BENCH_PROBE_DEADLINE", "420"))
+    probe_timeout = float(os.environ.get("DDS_BENCH_PROBE_TIMEOUT", "75"))
+    probe_sleep = float(os.environ.get("DDS_BENCH_PROBE_SLEEP", "45"))
+    worker_timeout = float(os.environ.get("DDS_BENCH_WORKER_TIMEOUT", "700"))
+    attempts = int(os.environ.get("DDS_BENCH_ATTEMPTS", "2"))
+
+    errors: list[str] = []
+    for attempt in range(1, attempts + 1):
+        if not _probe_loop(probe_deadline, probe_timeout, probe_sleep):
+            errors.append(f"attempt {attempt}: device probe never succeeded")
+            continue
+        _log(f"worker attempt {attempt} (timeout {worker_timeout:.0f}s)")
+        rc, out, err = _run_sub(
+            [sys.executable, "-u", os.path.join(REPO, "bench.py"), "--worker"],
+            worker_timeout,
+        )
+        row = _parse_worker_json(out)
+        if row is not None:
+            # the measurement completed and was printed — keep it even if
+            # the worker then died/hung in teardown (wedged tunnel threads
+            # can hang interpreter exit after the work is done)
+            if rc != 0:
+                row.setdefault("detail", {})["worker_exit"] = (
+                    "killed/timeout" if rc is None else f"rc={rc}"
+                )
+            return row
+        reason = "hang/timeout" if rc is None else f"rc={rc}"
+        tail = (err or out).strip().splitlines()[-1:] or [""]
+        errors.append(f"attempt {attempt}: worker {reason}: {tail[0][:300]}")
+        _log(errors[-1])
+
+    # unrecoverable: emit the failure shape + CPU baseline, never a traceback
+    detail: dict = {"errors": errors}
+    try:
+        detail.update(_cpu_fallback_detail())
+    except Exception as e:  # noqa: BLE001 — the JSON line must still go out
+        detail["cpu_fallback_error"] = repr(e)
+    return {
+        "metric": METRIC,
+        "value": None,
+        "unit": "ops/s",
+        "vs_baseline": None,
+        "error": "TPU unavailable after probe/retry; see detail.errors",
+        "detail": detail,
+    }
+
+
+def main() -> int:
+    if "--worker" in sys.argv[1:]:
+        print(json.dumps(bench()), flush=True)
+        return 0
+    try:
+        row = _driver()
+    except Exception as e:  # noqa: BLE001 — the JSON line must still go out
+        row = {
+            "metric": METRIC,
+            "value": None,
+            "unit": "ops/s",
+            "vs_baseline": None,
+            "error": f"driver crashed: {e!r}",
+        }
+    print(json.dumps(row), flush=True)
+    return 0
+
+
 if __name__ == "__main__":
-    result = bench()
-    print(json.dumps(result))
+    sys.exit(main())
