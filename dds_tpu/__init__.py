@@ -43,6 +43,39 @@ def _setup_jax_compilation_cache() -> None:
     )
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", path)
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+    # If a consumer (or the interpreter's sitecustomize) imported jax before
+    # us, jax has already read its env; apply the setting via jax.config so
+    # the persistent cache is enabled regardless of import order.
+    import sys
+
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+        )
+
+
+def _honor_jax_platforms() -> None:
+    """Re-assert the JAX_PLATFORMS env var when jax was imported early.
+
+    Some environments (e.g. a sitecustomize that registers a PJRT plugin for
+    every interpreter) import jax before user code runs and re-register
+    accelerator platforms, so a parent process's `JAX_PLATFORMS=cpu` is
+    silently ignored — and the first `jax.default_backend()` then initializes
+    the accelerator plugin, which can hang outright when the device link is
+    down. Applying the env var through jax.config restores the documented
+    contract: JAX_PLATFORMS=cpu means CPU, always.
+    """
+    import os
+    import sys
+
+    val = os.environ.get("JAX_PLATFORMS", "").strip()
+    if val and "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", val)
 
 
 _setup_jax_compilation_cache()
+_honor_jax_platforms()

@@ -46,11 +46,34 @@ class IWrite:
 class IReadReply:
     key: str
     set: Optional[DDSSet]
+    # tag of the returned value (the write-back tag). Lets the proxy keep a
+    # tag-validated aggregate cache; NOT covered by the proxy HMAC (the
+    # coordinator computes that HMAC anyway — a lying tag can only cause a
+    # spurious re-fetch, never a stale serve, see http/server.py cache notes).
+    tag: Optional[ABDTag] = None
 
 
 @dataclass(frozen=True)
 class IWriteReply:
     key: str
+    tag: Optional[ABDTag] = None  # the tag the coordinator wrote (see above)
+
+
+@dataclass(frozen=True)
+class ITagRead:
+    """Batched freshness probe: current max tag for each key, via ONE quorum
+    round of small tag-only messages (no set contents travel). This is the
+    aggregate-cache validation op the reference lacks — it re-reads every
+    stored set through full ABD quorums per aggregate instead
+    (`dds/http/DDSRestServer.scala:397-446`)."""
+
+    keys: tuple
+
+
+@dataclass(frozen=True)
+class ITagReply:
+    digest: str   # SHA-512 over the requested key list (echo check)
+    tags: tuple   # ABDTag per requested key, same order
 
 
 @dataclass(frozen=True)
@@ -97,6 +120,23 @@ class WriteAck:
 @dataclass(frozen=True)
 class Read:
     key: str
+    nonce: int
+
+
+@dataclass(frozen=True)
+class ReadTagBatch:
+    """Tag-phase-only quorum read over many keys at once (no Write phase
+    follows). Replies carry tags, never contents."""
+
+    keys: tuple
+    nonce: int
+
+
+@dataclass(frozen=True)
+class TagBatchReply:
+    tags: tuple   # ABDTag per key in the request's order
+    digest: str
+    signature: bytes
     nonce: int
 
 
@@ -177,8 +217,9 @@ class Compromise:
 _TYPES = {
     cls.__name__: cls
     for cls in (
-        IRead, IWrite, IReadReply, IWriteReply, Envelope,
+        IRead, IWrite, IReadReply, IWriteReply, ITagRead, ITagReply, Envelope,
         ReadTag, TagReply, Write, WriteAck, Read, ReadReply,
+        ReadTagBatch, TagBatchReply,
         Suspect, Awake, State, Sleep, Complying, Kill,
         RequestReplicas, ActiveReplicas, Compromise,
     )
@@ -207,15 +248,31 @@ def _dec(v):
 
 
 def to_dict(msg) -> dict:
+    # element-wise coding applies ONLY to the tuple-typed protocol fields
+    # (tag vectors / key tuples of the batch messages). Stored set contents
+    # (list fields) stay opaque: recursing into them would let a crafted
+    # client column value (e.g. {"__msg__": ...}) be (de)coded as a protocol
+    # object inside the receive path, before any MAC validation.
     d = {"__msg__": type(msg).__name__}
     for f in fields(msg):
-        d[f.name] = _enc(getattr(msg, f.name))
+        v = getattr(msg, f.name)
+        if f.type == "tuple" and isinstance(v, (list, tuple)):
+            d[f.name] = [_enc(x) for x in v]
+        else:
+            d[f.name] = _enc(v)
     return d
 
 
 def from_dict(d: dict):
     cls = _TYPES[d["__msg__"]]
-    kwargs = {f.name: _dec(d[f.name]) for f in fields(cls)}
+    kwargs = {}
+    for f in fields(cls):
+        v = d[f.name]
+        if f.type == "tuple" and isinstance(v, list):  # JSON has no tuples
+            v = tuple(_dec(x) for x in v)
+        else:
+            v = _dec(v)
+        kwargs[f.name] = v
     return cls(**kwargs)
 
 

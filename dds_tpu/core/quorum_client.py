@@ -98,6 +98,11 @@ class AbdClient:
 
     async def fetch_set(self, key: str):
         """Quorum read; returns the stored set (list) or None."""
+        return (await self.fetch_set_tagged(key))[0]
+
+    async def fetch_set_tagged(self, key: str):
+        """Quorum read; returns (set|None, tag) — the tag of the value the
+        coordinator wrote back, for tag-validated caching."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce)
         with tracer.span("abd.fetch"):
@@ -105,7 +110,7 @@ class AbdClient:
 
         cfg = self.cfg
         match reply:
-            case M.Envelope(M.IReadReply(k, value), rnonce, rsig):
+            case M.Envelope(M.IReadReply(k, value, tag), rnonce, rsig):
                 if rnonce != challenge:
                     self.replicas.increment_suspicion(coord)
                     raise ByzFailedNonceChallengeError(coord)
@@ -117,13 +122,17 @@ class AbdClient:
                 if k != key:
                     self.replicas.increment_suspicion(coord)
                     raise ByzInvalidKeyError(coord)
-                return value
+                return value, tag
             case _:
                 self.replicas.increment_suspicion(coord)
                 raise ByzUnknownReplyError(coord)
 
     async def write_set(self, key: str, value) -> str:
         """Quorum write (value=None removes); returns the key on success."""
+        return (await self.write_set_tagged(key, value))[0]
+
+    async def write_set_tagged(self, key: str, value):
+        """Quorum write; returns (key, tag) where tag is the tag written."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce, value)
         with tracer.span("abd.write"):
@@ -131,7 +140,7 @@ class AbdClient:
 
         cfg = self.cfg
         match reply:
-            case M.Envelope(M.IWriteReply(k), rnonce, rsig):
+            case M.Envelope(M.IWriteReply(k, tag), rnonce, rsig):
                 if rnonce != challenge:
                     self.replicas.increment_suspicion(coord)
                     raise ByzFailedNonceChallengeError(coord)
@@ -141,7 +150,40 @@ class AbdClient:
                 if k != key:
                     self.replicas.increment_suspicion(coord)
                     raise ByzInvalidKeyError(coord)
-                return k
+                return k, tag
+            case _:
+                self.replicas.increment_suspicion(coord)
+                raise ByzUnknownReplyError(coord)
+
+    async def read_tags(self, keys: list[str]) -> list[M.ABDTag]:
+        """Batched freshness probe: the quorum-max tag per key via ONE
+        tag-only quorum round (`ITagRead` -> `ReadTagBatch` fan-out). Cheap
+        because no set contents travel — the cache-validation primitive
+        behind the proxy's aggregate cache."""
+        nonce = sigs.generate_nonce()
+        digest = sigs.key_from_set(list(keys))
+        sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, digest, nonce)
+        with tracer.span("abd.read_tags", k=len(keys)):
+            reply, coord, challenge = await self._ask(
+                M.ITagRead(tuple(keys)), nonce, sig
+            )
+
+        cfg = self.cfg
+        match reply:
+            case M.Envelope(M.ITagReply(rdigest, tags), rnonce, rsig):
+                if rnonce != challenge:
+                    self.replicas.increment_suspicion(coord)
+                    raise ByzFailedNonceChallengeError(coord)
+                if not sigs.validate_proxy_signature(
+                    cfg.proxy_mac_secret, rdigest, rnonce, rsig,
+                    sigs.tags_payload(tags),
+                ):
+                    self.replicas.increment_suspicion(coord)
+                    raise ByzInvalidSignatureError(coord)
+                if rdigest != digest or len(tags) != len(keys):
+                    self.replicas.increment_suspicion(coord)
+                    raise ByzInvalidKeyError(coord)
+                return list(tags)
             case _:
                 self.replicas.increment_suspicion(coord)
                 raise ByzUnknownReplyError(coord)

@@ -59,6 +59,7 @@ class _Outgoing:
     write_quorum: set = field(default_factory=set)
     set_to_read: object = None
     set_to_write: object = None
+    tag_to_reply: object = None  # tag returned to the proxy (read max / written)
 
 
 class BFTABDNode:
@@ -79,6 +80,12 @@ class BFTABDNode:
         self.net = net
         self.cfg = config or ReplicaConfig()
         self.behavior = "healthy"
+        # monotonic floor for tags this coordinator mints: two concurrent
+        # writes coordinated here could otherwise read the same quorum-max
+        # and mint the SAME (seq+1, self) tag for different values (latent
+        # in the reference too, `BFTABDNode.scala:194`); the floor keeps
+        # locally-minted tags unique. Deviation documented per SURVEY.md §7.
+        self._seq_floor = 0
         self.repository: dict[str, tuple[M.ABDTag, object]] = {}
         self.outgoing: dict[int, _Outgoing] = {}
         self.incoming: dict[int, bool] = {}  # nonce -> expired
@@ -142,6 +149,14 @@ class BFTABDNode:
                         else:
                             req.set_to_write = value
                             self._broadcast(M.ReadTag(key, nonce))
+                    case M.ITagRead(keys):
+                        digest = sigs.key_from_set(list(keys))
+                        if not sigs.validate_proxy_signature(
+                            cfg.proxy_mac_secret, digest, nonce, signature
+                        ):
+                            self._debug("invalid proxy signature (tag read)")
+                        else:
+                            self._broadcast(M.ReadTagBatch(tuple(keys), nonce))
                     case _:
                         log.error("unexpected API call from proxy: %r", call)
                 self.outgoing[nonce] = req
@@ -155,6 +170,63 @@ class BFTABDNode:
                 tag, contents = self._state(key)
                 sig = sigs.abd_signature(cfg.abd_mac_secret, contents, tag, nonce)
                 self._send(sender, M.TagReply(tag, key, contents, sig, nonce))
+
+            case M.ReadTagBatch(keys, nonce):
+                if nonce in self.incoming:
+                    self._debug("invalid nonce - repeated (tag batch)")
+                    self._suspect(sender)
+                    return
+                # tag-only phase: no Write follows, so the nonce is spent now
+                self.incoming[nonce] = True
+                # read without materializing default entries in the repository
+                blank = (M.ABDTag(0, self.name), None)
+                tags = tuple(self.repository.get(k, blank)[0] for k in keys)
+                digest = sigs.key_from_set(list(keys))
+                sig = sigs.abd_batch_signature(cfg.abd_mac_secret, tags, digest, nonce)
+                self._send(sender, M.TagBatchReply(tags, digest, sig, nonce))
+
+            case M.TagBatchReply(tags, digest, signature, nonce):
+                if not sigs.validate_abd_batch_signature(
+                    cfg.abd_mac_secret, tags, digest, nonce, signature
+                ):
+                    self._debug("invalid ABD batch signature")
+                    self._suspect(sender)
+                    return
+                req = self.outgoing.get(nonce)
+                if req is None:
+                    self._debug("invalid nonce - unknown (tag batch)")
+                    self._suspect(sender)
+                    return
+                if req.expired:
+                    self._debug("invalid nonce - expired (late tag batch reply)")
+                    return
+                if not isinstance(req.call, M.ITagRead):
+                    self._debug("TagBatchReply for a non-tag-read request")
+                    self._suspect(sender)
+                    return
+                keys = tuple(req.call.keys)
+                if len(tags) != len(keys):
+                    self._debug("tag batch reply has wrong arity")
+                    self._suspect(sender)
+                    return
+                req.read_quorum[sender] = tuple(tags)
+                if len(req.read_quorum) >= cfg.quorum_size:
+                    vectors = list(req.read_quorum.values())
+                    req.read_quorum = {}
+                    req.expired = True
+                    max_tags = tuple(max(col) for col in zip(*vectors)) if keys else ()
+                    challenge = req.client_nonce + cfg.nonce_increment
+                    reply_digest = sigs.key_from_set(list(keys))
+                    psig = sigs.proxy_signature(
+                        cfg.proxy_mac_secret,
+                        reply_digest,
+                        challenge,
+                        sigs.tags_payload(max_tags),
+                    )
+                    self._send(
+                        req.client,
+                        M.Envelope(M.ITagReply(reply_digest, max_tags), challenge, psig),
+                    )
 
             case M.TagReply(tag, key, value, signature, nonce):
                 if not sigs.validate_abd_signature(
@@ -171,11 +243,20 @@ class BFTABDNode:
                 if req.expired:
                     self._debug("invalid nonce - expired (late quorum reply)")
                     return
+                if not isinstance(req.call, M.IWrite):
+                    # a reply type must match its request's phase: a forged
+                    # TagReply against a read/tag-read nonce would otherwise
+                    # pollute that quorum accumulator
+                    self._debug("TagReply for a non-write request")
+                    self._suspect(sender)
+                    return
                 req.read_quorum[sender] = (tag, value, signature)
                 if len(req.read_quorum) >= cfg.quorum_size:
                     max_tag = max(t for t, _, _ in req.read_quorum.values())
                     req.read_quorum = {}
-                    new_tag = M.ABDTag(max_tag.seq + 1, self.name)
+                    self._seq_floor = max(self._seq_floor, max_tag.seq) + 1
+                    new_tag = M.ABDTag(self._seq_floor, self.name)
+                    req.tag_to_reply = new_tag
                     sig = sigs.abd_signature(
                         cfg.abd_mac_secret, req.set_to_write, new_tag, nonce
                     )
@@ -210,6 +291,10 @@ class BFTABDNode:
                 if req.expired:
                     self._debug("invalid nonce - expired at WriteAck (late reply)")
                     return
+                if not isinstance(req.call, (M.IRead, M.IWrite)):
+                    self._debug("WriteAck for a request with no write phase")
+                    self._suspect(sender)
+                    return
                 req.write_quorum.add(sender)
                 if len(req.write_quorum) >= cfg.quorum_size:
                     req.write_quorum = set()
@@ -222,12 +307,23 @@ class BFTABDNode:
                             )
                             self._send(
                                 req.client,
-                                M.Envelope(M.IReadReply(k, req.set_to_read), challenge, sig),
+                                M.Envelope(
+                                    M.IReadReply(
+                                        k, req.set_to_read, tag=req.tag_to_reply
+                                    ),
+                                    challenge,
+                                    sig,
+                                ),
                             )
                         case M.IWrite(k, _):
                             sig = sigs.proxy_signature(cfg.proxy_mac_secret, k, challenge)
                             self._send(
-                                req.client, M.Envelope(M.IWriteReply(k), challenge, sig)
+                                req.client,
+                                M.Envelope(
+                                    M.IWriteReply(k, tag=req.tag_to_reply),
+                                    challenge,
+                                    sig,
+                                ),
                             )
 
             case M.Read(key, nonce):
@@ -255,6 +351,10 @@ class BFTABDNode:
                 if req.expired:
                     self._debug("invalid nonce - expired at ReadReply (late reply)")
                     return
+                if not isinstance(req.call, M.IRead):
+                    self._debug("ReadReply for a non-read request")
+                    self._suspect(sender)
+                    return
                 req.read_quorum[sender] = (tag, value, signature)
                 if len(req.read_quorum) >= cfg.quorum_size:
                     max_tag, max_val, max_sig = max(
@@ -262,6 +362,7 @@ class BFTABDNode:
                     )
                     req.read_quorum = {}
                     req.set_to_read = max_val
+                    req.tag_to_reply = max_tag
                     # ABD write-back phase, re-using the original signature
                     self._broadcast(M.Write(max_tag, key, max_val, max_sig, nonce))
 
@@ -344,6 +445,14 @@ class BFTABDNode:
                         sender,
                         M.TagReply(M.ABDTag(0, self.name), key, garbage, b"", nonce),
                     )
+
+            case M.ReadTagBatch(keys, nonce):
+                # inflated tags under an empty signature, replayed x2: an
+                # honest coordinator drops these on MAC failure; even if the
+                # tags landed they could only force spurious cache re-fetches
+                fake = tuple(M.ABDTag(1 << 30, self.name) for _ in keys)
+                for _ in range(2):
+                    self._send(sender, M.TagBatchReply(fake, "forged", b"", nonce))
 
             case M.TagReply(_, key, _, _, nonce) | M.ReadReply(_, key, _, _, nonce):
                 # forge a write to every replica under a random tag

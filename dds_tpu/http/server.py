@@ -49,6 +49,11 @@ class ProxyConfig:
     retry_backoff: float = 0.3
     retry_attempts: int = 2
     crypto_backend: str = "cpu"
+    # tag-validated aggregate cache (see _fetch_stored): one batched
+    # tag-only quorum round validates all cached sets per aggregate instead
+    # of K full ABD re-reads. Off = reference behavior
+    # (`DDSRestServer.scala:397-446` re-reads every set, cache-less).
+    aggregate_cache: bool = True
     # proxy->proxy key gossip (DDSRestServer.scala:118-136)
     key_sync_enabled: bool = False
     key_sync_warmup: float = 1.0
@@ -67,6 +72,11 @@ class DDSRestServer:
         self.cfg = config or ProxyConfig()
         self.backend: CryptoBackend = get_backend(self.cfg.crypto_backend)
         self.stored_keys: set[str] = set()
+        # key -> (tag, value): every entry comes from a COMPLETED quorum op
+        # (read with write-back, or write), so value@tag is known to be
+        # written to a full quorum — the invariant the tag-validation read
+        # path relies on for linearizability.
+        self._cache: dict[str, tuple] = {}
         self._http = HttpServer(
             self.cfg.host, self.cfg.port, self.handle, self.cfg.ssl_server_context
         )
@@ -130,30 +140,78 @@ class DDSRestServer:
 
     # ----------------------------------------------------------- ABD access
 
-    async def _fetch(self, key: str):
-        return await retry(
-            lambda: self.abd.fetch_set(key), self.cfg.retry_backoff, self.cfg.retry_attempts
-        )
+    def _cache_put(self, key: str, tag, value) -> None:
+        """Remember a completed op's (tag, value); newest tag wins (two
+        interleaved ops on one key may resolve out of order here)."""
+        if tag is None:
+            return
+        cur = self._cache.get(key)
+        if cur is None or cur[0] < tag:
+            self._cache[key] = (tag, value)
 
-    async def _write(self, key: str, value):
-        return await retry(
-            lambda: self.abd.write_set(key, value),
+    async def _fetch(self, key: str):
+        value, tag = await retry(
+            lambda: self.abd.fetch_set_tagged(key),
             self.cfg.retry_backoff,
             self.cfg.retry_attempts,
         )
+        self._cache_put(key, tag, value)
+        return value
+
+    async def _write(self, key: str, value):
+        k, tag = await retry(
+            lambda: self.abd.write_set_tagged(key, value),
+            self.cfg.retry_backoff,
+            self.cfg.retry_attempts,
+        )
+        self._cache_put(key, tag, value)
+        return k
 
     async def _fetch_stored(self) -> list[tuple[str, list]]:
-        """Fetch every stored key in parallel; keep the ones that exist."""
+        """Every stored (key, value), for the aggregate/search routes.
+
+        With the aggregate cache on, ONE batched tag-only quorum round
+        (`AbdClient.read_tags`) validates all cached entries: a cached value
+        is served only when the quorum-max tag EQUALS its cached tag, which
+        is linearizable because cached values come from completed ops (fully
+        written back at that tag) and any completed later write would show a
+        higher tag in every quorum (they intersect in an honest replica). A
+        lying replica can only inflate tags, forcing a spurious re-fetch —
+        never a stale serve. Keys that fail validation (or were never
+        cached) take the full ABD read, refilling the cache.
+
+        The reference re-reads every set through full quorums per aggregate
+        (`DDSRestServer.scala:397-446`); this replaces K 2-round-trip reads
+        with 1 light round + reads for just the stale keys.
+        """
         keys = sorted(self.stored_keys)
+        if not keys:
+            return []
+        fresh: dict[str, object] = {}
+        cached = [k for k in keys if k in self._cache]
+        if self.cfg.aggregate_cache and cached:
+            try:
+                tags = await self.abd.read_tags(cached)
+                for k, t in zip(cached, tags):
+                    ct, cv = self._cache[k]
+                    if t == ct:
+                        fresh[k] = cv
+            except Exception as e:  # validation trouble => plain full fetch
+                log.debug("tag validation failed (%s); full refetch", e)
+        stale = [k for k in keys if k not in fresh]
         results = await asyncio.gather(
-            *(self._fetch(k) for k in keys), return_exceptions=True
+            *(self._fetch(k) for k in stale), return_exceptions=True
         )
-        out = []
-        for k, r in zip(keys, results):
+        fetched = {}
+        for k, r in zip(stale, results):
             if isinstance(r, Exception):
                 raise r
-            if r is not None:
-                out.append((k, r))
+            fetched[k] = r
+        out = []
+        for k in keys:
+            v = fresh[k] if k in fresh else fetched[k]
+            if v is not None:
+                out.append((k, v))
         return out
 
     # -------------------------------------------------------------- routing
@@ -362,13 +420,15 @@ class DDSRestServer:
         if mod:
             modulus = self._parse_modulus(mod, modparam)
             # device-resident path when the backend has a cipher store:
-            # quorum reads above are still authoritative; the store only
-            # memoizes limb conversion + transfer (ops/store.py)
-            fold_resident = getattr(self.backend, "modmul_fold_resident", None)
-            if fold_resident is not None:
-                result = fold_resident(operands, modulus)
-            else:
-                result = self.backend.modmul_fold(operands, modulus)
+            # quorum/tag validation above is still authoritative; the store
+            # only memoizes limb conversion + transfer (ops/store.py).
+            # The fold runs in a worker thread so concurrent aggregate
+            # requests overlap their device dispatches (and the event loop
+            # keeps serving) instead of serializing on a blocking fetch.
+            fold = getattr(
+                self.backend, "modmul_fold_resident", self.backend.modmul_fold
+            )
+            result = await asyncio.to_thread(fold, operands, modulus)
         elif modparam == "nsqr":
             result = sum(operands)
         else:

@@ -103,7 +103,10 @@ class TpuBackend:
             if min_device_batch is None
             else min_device_batch
         )
+        import threading
+
         self._stores: dict[int, object] = {}
+        self._stores_lock = threading.Lock()  # folds run on proxy threads
 
     @staticmethod
     def _host_fold(cs: list[int], modulus: int) -> int:
@@ -114,16 +117,17 @@ class TpuBackend:
 
     def store_for(self, modulus: int):
         """Per-modulus device-resident cipher store (ops/store.py)."""
-        store = self._stores.get(modulus)
-        if store is None:
-            from dds_tpu.ops.store import DeviceCipherStore
+        with self._stores_lock:
+            store = self._stores.get(modulus)
+            if store is None:
+                from dds_tpu.ops.store import DeviceCipherStore
 
-            ctx = ModCtx.make(modulus)
-            store = DeviceCipherStore(
-                modulus, reduce=lambda rows: self.reduce_mul_device(ctx, rows)
-            )
-            self._stores[modulus] = store
-        return store
+                ctx = ModCtx.make(modulus)
+                store = DeviceCipherStore(
+                    modulus, reduce=lambda rows: self.reduce_mul_device(ctx, rows)
+                )
+                self._stores[modulus] = store
+            return store
 
     def modmul_fold_resident(self, cs: list[int], modulus: int) -> int:
         """Fold via the device store: unseen ciphertexts ingest once, the

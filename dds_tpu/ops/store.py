@@ -20,6 +20,7 @@ pays exactly the one-time ingest cost again, never wrong results.
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -57,6 +58,10 @@ class DeviceCipherStore:
             self.reduce = self._ctx.reduce_mul
         self._buf = jnp.zeros((self.initial_rows, self._ctx.L), jnp.uint32)
         self._index = {}
+        # folds may run on proxy worker threads; ingest (index+buffer
+        # mutation) must be serialized. Reads gather from an immutable
+        # buffer snapshot, so only `ensure` needs the lock.
+        self._lock = threading.Lock()
 
     @property
     def resident(self) -> int:
@@ -84,8 +89,11 @@ class DeviceCipherStore:
         pad = jnp.zeros((cap - self.capacity, self._ctx.L), jnp.uint32)
         self._buf = jnp.concatenate([self._buf, pad], axis=0)
 
-    def ensure(self, cs: list[int]) -> np.ndarray | None:
+    def ensure(self, cs: list[int], pre: dict | None = None) -> np.ndarray | None:
         """Ingest any unseen ciphertexts; return row indices for all of cs.
+        Caller must hold `_lock`. `pre` optionally maps ciphertext -> already
+        limb-converted row (fold() precomputes these OUTSIDE the lock so the
+        CPU-heavy conversion never serializes concurrent folds).
 
         Returns None when the distinct operands cannot fit even after a
         reset (aggregate wider than max_rows) — callers fall back to a
@@ -100,7 +108,12 @@ class DeviceCipherStore:
                 missing = sorted({c for c in cs if c not in self._index})
             if self._count + len(missing) > self.capacity:
                 return None  # wider than max_rows even when empty
-            rows = bn.ints_to_batch([c % self.modulus for c in missing], self._ctx.L)
+            if pre is not None and all(c in pre for c in missing):
+                rows = np.stack([pre[c] for c in missing])
+            else:
+                rows = bn.ints_to_batch(
+                    [c % self.modulus for c in missing], self._ctx.L
+                )
             start = self._count
             self._buf = jax.lax.dynamic_update_slice(
                 self._buf, jnp.asarray(rows), (start, 0)
@@ -116,13 +129,33 @@ class DeviceCipherStore:
 
         if not cs:
             return 1 % self.modulus
-        idx = self.ensure(cs)
+        # fast path: everything resident — only a brief lock for the lookup
+        with self._lock:
+            missing = sorted({c for c in cs if c not in self._index})
+            if not missing:
+                idx = np.asarray([self._index[c] for c in cs], dtype=np.int32)
+                buf = self._buf  # immutable jax array: safe to gather outside
+            else:
+                idx = buf = None
+        if buf is None:
+            # limb-convert the unseen operands OUTSIDE the lock (the
+            # CPU-heavy part); placement/index update stays serialized.
+            # Entries are only ever added, so `missing` can only shrink in
+            # between; ensure() recomputes it under the lock (and converts
+            # inline in the rare capacity-reset case where `pre` is short).
+            converted = bn.ints_to_batch(
+                [c % self.modulus for c in missing], self._ctx.L
+            )
+            pre = {c: converted[i] for i, c in enumerate(missing)}
+            with self._lock:
+                idx = self.ensure(cs, pre)
+                buf = self._buf
         if idx is None:  # aggregate wider than the store: direct fold
             rows = jnp.asarray(
                 bn.ints_to_batch([c % self.modulus for c in cs], self._ctx.L)
             )
         else:
-            rows = jnp.take(self._buf, jnp.asarray(idx), axis=0)
+            rows = jnp.take(buf, jnp.asarray(idx), axis=0)
         with tracer.span("kernel.fold", k=len(cs), resident=idx is not None):
             out = self.reduce(rows)
             return bn.limbs_to_int(np.asarray(out)[0])

@@ -53,6 +53,26 @@ def validate_abd_signature(secret: bytes, value, tag, nonce: int, given: bytes) 
     return hmac.compare_digest(abd_signature(secret, value, tag, nonce), given)
 
 
+def tags_payload(tags) -> list:
+    """Canonical JSON-safe form of a tag vector for signing: [[seq, id], ...].
+    Both the replica (signer) and proxy (verifier) derive this from their own
+    ABDTag objects so wire-codec differences can't skew the MAC input."""
+    return [[t.seq, t.id] for t in tags]
+
+
+def abd_batch_signature(secret: bytes, tags, digest: str, nonce: int) -> bytes:
+    """Intranet replica signature over a ReadTagBatch reply (tag vector +
+    requested-keys digest + nonce) — the batched analogue of abd_signature."""
+    content = f"{canonical(tags_payload(tags))}|{digest}|{nonce}".encode()
+    return _mac(secret, content)
+
+
+def validate_abd_batch_signature(
+    secret: bytes, tags, digest: str, nonce: int, given: bytes
+) -> bool:
+    return hmac.compare_digest(abd_batch_signature(secret, tags, digest, nonce), given)
+
+
 _NO_VALUE = object()
 
 

@@ -1,0 +1,253 @@
+"""Tag-read protocol + proxy aggregate-cache tests.
+
+The batched tag-only quorum read (`ITagRead`/`ReadTagBatch`) and the
+proxy's tag-validated aggregate cache replace the reference's per-aggregate
+full re-read of every stored set (`dds/http/DDSRestServer.scala:397-446`).
+These tests pin the safety argument: a cached value is served only when the
+quorum-max tag equals its cached tag, so external writes are always
+observed and Byzantine replicas can at worst force spurious re-fetches.
+"""
+
+import asyncio
+import json
+
+from dds_tpu.core import messages as M
+from dds_tpu.core.errors import ByzantineError
+from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
+
+from test_core import Cluster, run
+from test_rest import PROVIDER, call, rest_stack
+
+
+# ------------------------------------------------------------ protocol level
+
+def test_read_tags_matches_completed_writes():
+    async def go():
+        c = Cluster()
+        await c.client.write_set("k1", [1])
+        await c.client.write_set("k2", [2])
+        v1, t1 = await c.client.fetch_set_tagged("k1")
+        v2, t2 = await c.client.fetch_set_tagged("k2")
+        assert v1 == [1] and v2 == [2]
+        tags = await c.client.read_tags(["k1", "k2"])
+        assert tags == [t1, t2]
+        # a new write must advance the quorum-max tag for that key only
+        await c.client.write_set("k1", [10])
+        tags2 = await c.client.read_tags(["k1", "k2"])
+        assert tags2[0] > t1 and tags2[1] == t2
+
+    run(go())
+
+
+def test_read_tags_unknown_key_is_zero_seq():
+    async def go():
+        c = Cluster()
+        (tag,) = await c.client.read_tags(["never-written"])
+        assert tag.seq == 0
+
+    run(go())
+
+
+def test_write_reply_tag_matches_quorum():
+    """The tag returned by write_set_tagged is exactly what a subsequent
+    tag read observes (the cache-update invariant)."""
+
+    async def go():
+        c = Cluster()
+        _, wtag = await c.client.write_set_tagged("k", [7])
+        assert wtag is not None and wtag.seq >= 1
+        tags = await c.client.read_tags(["k"])
+        assert tags == [wtag]
+
+    run(go())
+
+
+def test_read_tags_tolerates_byzantine_minority():
+    async def go():
+        c = Cluster()  # n=7, q=5, f=2
+        await c.client.write_set("k", [3])
+        _, t = await c.client.fetch_set_tagged("k")
+        for addr in ("replica-5", "replica-6"):
+            c.replicas[addr].behavior = "byzantine"
+        for _ in range(20):  # byzantine coordinator draws raise; honest wins
+            try:
+                tags = await c.client.read_tags(["k"])
+                break
+            except (ByzantineError, asyncio.TimeoutError):
+                continue
+        else:
+            raise AssertionError("read_tags never succeeded past byzantine minority")
+        assert tags == [t]
+
+    run(go())
+
+
+def test_tag_messages_serialization_roundtrip():
+    msgs = [
+        M.ITagRead(("a", "b")),
+        M.ITagReply("digest", (M.ABDTag(1, "r0"), M.ABDTag(2, "r1"))),
+        M.ReadTagBatch(("a",), 42),
+        M.TagBatchReply((M.ABDTag(3, "r2"),), "digest", b"\x01\x02", 42),
+    ]
+    for m in msgs:
+        assert M.loads(M.dumps(m)) == m
+
+
+def test_crafted_column_values_stay_opaque():
+    """Stored set contents are client data: codec markers inside them must
+    survive as plain data, never be decoded as protocol objects (that would
+    crash or transform messages in the receive path before MAC checks)."""
+    row = [1, {"__msg__": "nope"}, {"__tag__": [5, "x"]}, {"__b64__": "AA=="}]
+    env = M.Envelope(M.IWrite("k", row), 1, b"s")
+    assert M.loads(M.dumps(env)) == env
+
+
+# --------------------------------------------------------------- proxy level
+
+def _count_fetches(server):
+    """Wrap the proxy's quorum read so tests can count full ABD fetches."""
+    counter = {"n": 0}
+    orig = server.abd.fetch_set_tagged
+
+    async def counted(key):
+        counter["n"] += 1
+        return await orig(key)
+
+    server.abd.fetch_set_tagged = counted
+    return counter
+
+
+def test_aggregate_cache_serves_warm_and_sees_external_writes():
+    async def go():
+        async with rest_stack() as (server, replicas, _):
+            pk = PROVIDER.keys.psse.public
+            vals = [11, 22, 33]
+            keys = []
+            for v in vals:
+                row = PROVIDER.encrypt_row([v], 1, ["PSSE"])
+                _, key = await call(server, "POST", "/PutSet", {"contents": row})
+                keys.append(key.decode())
+            counter = _count_fetches(server)
+            target = f"/SumAll?position=0&nsqr={pk.nsquare}"
+
+            # cold-ish: PutSet already cached each row, so zero full fetches
+            _, data = await call(server, "GET", target)
+            assert PROVIDER.keys.psse.decrypt(int(json.loads(data)["result"])) == sum(vals)
+            assert counter["n"] == 0
+
+            # external writer (another proxy's quorum client) bumps one key
+            other = AbdClient(
+                "proxy-ext", server.abd.net, list(replicas),
+                AbdClientConfig(request_timeout=2.0),
+            )
+            new_row = PROVIDER.encrypt_row([100], 1, ["PSSE"])
+            await other.write_set(keys[0], new_row)
+
+            # tag validation must spot exactly that one stale key
+            _, data = await call(server, "GET", target)
+            got = PROVIDER.keys.psse.decrypt(int(json.loads(data)["result"]))
+            assert got == 100 + 22 + 33
+            assert counter["n"] == 1
+
+            # steady state again: all fresh, no fetches
+            _, data = await call(server, "GET", target)
+            assert counter["n"] == 1
+
+    asyncio.run(go())
+
+
+def test_aggregate_cache_disabled_refetches_everything():
+    async def go():
+        async with rest_stack() as (server, _, _):
+            server.cfg.aggregate_cache = False
+            pk = PROVIDER.keys.psse.public
+            vals = [5, 6]
+            for v in vals:
+                row = PROVIDER.encrypt_row([v], 1, ["PSSE"])
+                await call(server, "POST", "/PutSet", {"contents": row})
+            counter = _count_fetches(server)
+            target = f"/SumAll?position=0&nsqr={pk.nsquare}"
+            for i in (1, 2):
+                _, data = await call(server, "GET", target)
+                assert (
+                    PROVIDER.keys.psse.decrypt(int(json.loads(data)["result"]))
+                    == sum(vals)
+                )
+                assert counter["n"] == len(vals) * i  # reference behavior
+
+    asyncio.run(go())
+
+
+def test_cached_aggregate_reads_are_atomic():
+    """The tag-validated cache path must preserve the atomic-register
+    properties under concurrent writers: no reads from the future, no
+    new/old inversion (same checker as tests/test_linearizability.py)."""
+
+    import random
+    import time
+
+    from dds_tpu.http.server import DDSRestServer, ProxyConfig
+    from dds_tpu.utils.retry import retry
+    from tests.test_linearizability import (
+        KEY, Recorder, _writer, check_atomic_register,
+    )
+
+    async def go():
+        c = Cluster()
+        rng = random.Random(11)
+        rec = Recorder()
+        server = DDSRestServer(
+            AbdClient(
+                "proxy-lin", c.net, c.active, AbdClientConfig(request_timeout=1.0)
+            ),
+            ProxyConfig(),
+        )
+        server.stored_keys.add(KEY)
+        t0 = time.monotonic()
+        await c.client.write_set(KEY, ["init"])
+        rec.record("write", "init", t0, time.monotonic())
+
+        async def cached_reader(n):
+            for _ in range(n):
+                t0 = time.monotonic()
+                pairs = await retry(server._fetch_stored, 0.01, 5)
+                v = pairs[0][1][0] if pairs else None
+                rec.record("read", v, t0, time.monotonic())
+                await asyncio.sleep(rng.uniform(0, 0.002))
+
+        await asyncio.gather(
+            _writer(c, rec, 0, 25, random.Random(1)),
+            _writer(c, rec, 1, 25, random.Random(2)),
+            cached_reader(60),
+            cached_reader(60),
+        )
+        check_atomic_register(rec.ops)
+        reads = [o for o in rec.ops if o["kind"] == "read"]
+        assert any(o["value"] is not None for o in reads)
+
+    run(go())
+
+
+def test_search_routes_use_validated_cache():
+    """Order/Search routes share _fetch_stored: results stay correct when
+    served from the validated cache after an external write."""
+
+    async def go():
+        async with rest_stack() as (server, replicas, _):
+            rows = {v: PROVIDER.encrypt_row([v], 1, ["OPE"]) for v in (1, 2, 3)}
+            keys = {}
+            for v, row in rows.items():
+                _, key = await call(server, "POST", "/PutSet", {"contents": row})
+                keys[v] = key.decode()
+            _, data = await call(server, "GET", "/OrderSL?position=0")
+            assert json.loads(data)["keyset"] == [keys[1], keys[2], keys[3]]
+
+            other = AbdClient(
+                "proxy-ext2", server.abd.net, list(replicas),
+                AbdClientConfig(request_timeout=2.0),
+            )
+            await other.write_set(keys[1], PROVIDER.encrypt_row([9], 1, ["OPE"]))
+            _, data = await call(server, "GET", "/OrderSL?position=0")
+            assert json.loads(data)["keyset"] == [keys[2], keys[3], keys[1]]
+
+    asyncio.run(go())
