@@ -60,6 +60,7 @@ class _Outgoing:
     set_to_read: object = None
     set_to_write: object = None
     tag_to_reply: object = None  # tag returned to the proxy (read max / written)
+    keys_digest: str = ""        # ITagRead: SHA-512 over keys, computed once
 
 
 class BFTABDNode:
@@ -156,6 +157,7 @@ class BFTABDNode:
                         ):
                             self._debug("invalid proxy signature (tag read)")
                         else:
+                            req.keys_digest = digest
                             self._broadcast(M.ReadTagBatch(tuple(keys), nonce))
                     case _:
                         log.error("unexpected API call from proxy: %r", call)
@@ -216,7 +218,7 @@ class BFTABDNode:
                     req.expired = True
                     max_tags = tuple(max(col) for col in zip(*vectors)) if keys else ()
                     challenge = req.client_nonce + cfg.nonce_increment
-                    reply_digest = sigs.key_from_set(list(keys))
+                    reply_digest = req.keys_digest
                     psig = sigs.proxy_signature(
                         cfg.proxy_mac_secret,
                         reply_digest,

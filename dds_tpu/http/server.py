@@ -54,6 +54,12 @@ class ProxyConfig:
     # of K full ABD re-reads. Off = reference behavior
     # (`DDSRestServer.scala:397-446` re-reads every set, cache-less).
     aggregate_cache: bool = True
+    # per-aggregate audit sample: this many cache-served keys are also
+    # re-read through a full quorum (random coordinator); any mismatch
+    # flushes the cache. Bounds how long a Byzantine COORDINATOR's forgery
+    # (valid proxy HMAC over a forged value + the true tag) can persist —
+    # without the audit a forged entry would keep validating by tag alone.
+    aggregate_cache_audit: int = 2
     # proxy->proxy key gossip (DDSRestServer.scala:118-136)
     key_sync_enabled: bool = False
     key_sync_warmup: float = 1.0
@@ -143,7 +149,7 @@ class DDSRestServer:
     def _cache_put(self, key: str, tag, value) -> None:
         """Remember a completed op's (tag, value); newest tag wins (two
         interleaved ops on one key may resolve out of order here)."""
-        if tag is None:
+        if tag is None or not self.cfg.aggregate_cache:
             return
         cur = self._cache.get(key)
         if cur is None or cur[0] < tag:
@@ -184,6 +190,8 @@ class DDSRestServer:
         (`DDSRestServer.scala:397-446`); this replaces K 2-round-trip reads
         with 1 light round + reads for just the stale keys.
         """
+        import random
+
         keys = sorted(self.stored_keys)
         if not keys:
             return []
@@ -191,14 +199,25 @@ class DDSRestServer:
         cached = [k for k in keys if k in self._cache]
         if self.cfg.aggregate_cache and cached:
             try:
-                tags = await self.abd.read_tags(cached)
+                tags = await retry(
+                    lambda: self.abd.read_tags(cached),
+                    self.cfg.retry_backoff,
+                    self.cfg.retry_attempts,
+                )
                 for k, t in zip(cached, tags):
                     ct, cv = self._cache[k]
                     if t == ct:
                         fresh[k] = cv
             except Exception as e:  # validation trouble => plain full fetch
                 log.debug("tag validation failed (%s); full refetch", e)
-        stale = [k for k in keys if k not in fresh]
+
+        # audit sample: re-read a few cache-served keys through a full
+        # quorum under a (random) coordinator; a mismatch means some past
+        # coordinator forged a cached value — flush everything
+        audit = random.sample(
+            sorted(fresh), min(self.cfg.aggregate_cache_audit, len(fresh))
+        )
+        stale = [k for k in keys if k not in fresh or k in audit]
         results = await asyncio.gather(
             *(self._fetch(k) for k in stale), return_exceptions=True
         )
@@ -207,9 +226,21 @@ class DDSRestServer:
             if isinstance(r, Exception):
                 raise r
             fetched[k] = r
+        if any(fetched[k] != fresh[k] for k in audit):
+            log.warning("aggregate cache audit mismatch: flushing cache")
+            self._cache.clear()
+            fresh.clear()  # serve only quorum-read data this round
+            remaining = [k for k in keys if k not in fetched]
+            more = await asyncio.gather(
+                *(self._fetch(k) for k in remaining), return_exceptions=True
+            )
+            for k, r in zip(remaining, more):
+                if isinstance(r, Exception):
+                    raise r
+                fetched[k] = r
         out = []
         for k in keys:
-            v = fresh[k] if k in fresh else fetched[k]
+            v = fetched[k] if k in fetched else fresh[k]
             if v is not None:
                 out.append((k, v))
         return out

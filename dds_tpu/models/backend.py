@@ -79,7 +79,7 @@ class TpuBackend:
     name = "tpu"
 
     def __init__(self, pallas: bool | None = None, min_device_batch: int | None = None,
-                 kernel: str | None = None):
+                 kernel: str | None = None, mesh=None):
         import os
 
         self.pallas = _use_pallas() if pallas is None else pallas
@@ -104,6 +104,16 @@ class TpuBackend:
             else min_device_batch
         )
         import threading
+
+        # Multi-chip scale-out (SURVEY.md §5.7-5.8): with a jax.sharding
+        # Mesh, folds/modexps shard the ciphertext axis over the devices via
+        # parallel/mesh.py (limb chains stay device-local; ONE all_gather
+        # combines partial products over ICI). Pass mesh= explicitly or set
+        # DDS_MESH=N to build an N-device mesh lazily at first use.
+        self.mesh = mesh
+        self._mesh_n = (
+            int(os.environ.get("DDS_MESH", "0")) if mesh is None else 0
+        )
 
         self._stores: dict[int, object] = {}
         self._stores_lock = threading.Lock()  # folds run on proxy threads
@@ -142,11 +152,24 @@ class TpuBackend:
         # one multiply: a device round-trip can never win
         return c1 * c2 % modulus
 
+    def _get_mesh(self):
+        if self.mesh is None and self._mesh_n > 1:
+            from dds_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(self._mesh_n)
+            self._mesh_n = 0
+        return self.mesh
+
     def reduce_mul_device(self, ctx: ModCtx, batch):
         """Modular product over an already-resident (K, L) limb batch.
 
         The device-level fold entry point shared by modmul_fold, the
         proxy's aggregate routes, and bench.py — one dispatch rule."""
+        mesh = self._get_mesh()
+        if mesh is not None and mesh.devices.size > 1:
+            from dds_tpu.parallel import mesh as pm
+
+            return pm.sharded_reduce_mul_fixed(ctx, batch, mesh)
         if self.pallas:
             if self.kernel == "v2":
                 from dds_tpu.ops import mont_mxu
@@ -168,6 +191,22 @@ class TpuBackend:
     def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
         ctx = ModCtx.make(modulus)
         batch = bn.ints_to_batch(bases, ctx.L)
+        mesh = self._get_mesh()
+        if mesh is not None and mesh.devices.size > 1:
+            from dds_tpu.ops.montgomery import _exp_to_digits
+            from dds_tpu.parallel import mesh as pm
+
+            D = mesh.devices.size
+            B = len(bases)
+            padded = -(-B // D) * D
+            if padded != B:  # pad with base 1 (1^e = 1), slice after
+                import jax.numpy as jnp
+
+                one = np.zeros((padded - B, ctx.L), np.uint32)
+                one[:, 0] = 1
+                batch = jnp.concatenate([jnp.asarray(batch), jnp.asarray(one)], 0)
+            out = pm.sharded_pow_mod(ctx, batch, _exp_to_digits(exp), mesh)
+            return bn.batch_to_ints(np.asarray(out)[:B])
         if self.pallas:
             from dds_tpu.ops import pallas_mont
 

@@ -37,6 +37,23 @@ def make_mesh(n_devices: int | None = None, axis: str = "batch") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+# jitted shard_map executables, keyed by (op, modulus, mesh, axis): the
+# serving path calls these per aggregate request, and rebuilding the
+# closure each call would defeat jax.jit's trace cache (jit keys on
+# function identity + shapes). Bounded FIFO (like ModCtx.make's lru_cache):
+# on the serving path the modulus comes from the client-supplied `nsqr`
+# query param, and each new modulus costs an XLA compile + retained
+# executable — unbounded growth would be a client-driven memory/compile DoS.
+_FN_CACHE: dict = {}
+_FN_CACHE_MAX = 64
+
+
+def _fn_cache_put(key, fn) -> None:
+    while len(_FN_CACHE) >= _FN_CACHE_MAX:
+        _FN_CACHE.pop(next(iter(_FN_CACHE)))
+    _FN_CACHE[key] = fn
+
+
 def _tree_reduce_local(cs, N, n0inv, one_mont):
     """Tree reduction (shard-local, no collectives), any leaf count.
 
@@ -71,25 +88,29 @@ def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch"):
         pad = jnp.broadcast_to(jnp.asarray(ctx.one_mont), (total - K, ctx.L))
         cs = jnp.concatenate([jnp.asarray(cs), pad], axis=0)
 
-    N = jnp.asarray(ctx.N)
-    n0inv = jnp.uint32(ctx.n0inv)
-    one_mont = jnp.asarray(ctx.one_mont)
+    key = ("reduce", ctx.n, mesh, axis)
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        N = jnp.asarray(ctx.N)
+        n0inv = jnp.uint32(ctx.n0inv)
+        one_mont = jnp.asarray(ctx.one_mont)
 
-    def step(local):
-        # local: (P2, L) on each device
-        partial = _tree_reduce_local(local, N, n0inv, one_mont)   # (1, L)
-        partials = jax.lax.all_gather(partial, axis, tiled=True)  # (D, L)
-        return _tree_reduce_local(partials, N, n0inv, one_mont)   # (1, L) replicated
+        def step(local):
+            # local: (P2, L) on each device
+            partial = _tree_reduce_local(local, N, n0inv, one_mont)   # (1, L)
+            partials = jax.lax.all_gather(partial, axis, tiled=True)  # (D, L)
+            return _tree_reduce_local(partials, N, n0inv, one_mont)   # (1, L) replicated
 
-    fn = jax.jit(
-        jax.shard_map(
-            step,
-            mesh=mesh,
-            in_specs=P(axis),
-            out_specs=P(),  # replicated result
-            check_vma=False,  # scan carries start replicated inside the shard
+        fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=P(axis),
+                out_specs=P(),  # replicated result
+                check_vma=False,  # scan carries start replicated inside the shard
+            )
         )
-    )
+        _fn_cache_put(key, fn)
     return fn(cs)
 
 
@@ -109,26 +130,32 @@ def sharded_pow_mod(ctx: ModCtx, bases, exp_digits, mesh: Mesh, axis: str = "bat
     (E,) uint32 4-bit MSB-first digits, replicated. Purely data-parallel —
     zero collectives; each device exponentiates its shard.
     """
-    N = jnp.asarray(ctx.N)
-    n0inv = jnp.uint32(ctx.n0inv)
-    R2 = jnp.asarray(ctx.R2)
-    one_mont = jnp.asarray(ctx.one_mont)
-    one_plain = np.zeros((ctx.L,), np.uint32)
-    one_plain[0] = 1
-    one_plain = jnp.asarray(one_plain)
+    key = ("pow", ctx.n, mesh, axis)
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        N = jnp.asarray(ctx.N)
+        n0inv = jnp.uint32(ctx.n0inv)
+        R2 = jnp.asarray(ctx.R2)
+        one_mont = jnp.asarray(ctx.one_mont)
+        one_plain = np.zeros((ctx.L,), np.uint32)
+        one_plain[0] = 1
+        one_plain = jnp.asarray(one_plain)
 
-    def step(local_bases, digits):
-        mont = _mont_mul_raw(local_bases, jnp.broadcast_to(R2, local_bases.shape), N, n0inv)
-        r = _mont_exp_raw(mont, digits, one_mont, N, n0inv)
-        return _mont_mul_raw(r, jnp.broadcast_to(one_plain, r.shape), N, n0inv)
+        def step(local_bases, digits):
+            mont = _mont_mul_raw(
+                local_bases, jnp.broadcast_to(R2, local_bases.shape), N, n0inv
+            )
+            r = _mont_exp_raw(mont, digits, one_mont, N, n0inv)
+            return _mont_mul_raw(r, jnp.broadcast_to(one_plain, r.shape), N, n0inv)
 
-    fn = jax.jit(
-        jax.shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(P(axis), P()),
-            out_specs=P(axis),
-            check_vma=False,  # scan carries start replicated inside the shard
+        fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(P(axis), P()),
+                out_specs=P(axis),
+                check_vma=False,  # scan carries start replicated inside the shard
+            )
         )
-    )
+        _fn_cache_put(key, fn)
     return fn(bases, jnp.asarray(exp_digits))

@@ -67,3 +67,54 @@ def test_sharded_reduce_non_power_of_two_mesh(D, K):
     for c in cs_int:
         want = want * c % n
     assert bn.limbs_to_int(np.asarray(out)[0]) == want
+
+
+# ----------------------------------------------------- serving-path wiring
+
+def test_tpu_backend_folds_through_mesh(monkeypatch):
+    """TpuBackend(mesh=...) routes reduce_mul_device and powmod_batch
+    through the sharded kernels — the serving-path wiring of §5.7."""
+    from dds_tpu.models.backend import TpuBackend
+    from dds_tpu.parallel import mesh as pm
+
+    calls = {"reduce": 0, "pow": 0}
+    orig_reduce, orig_pow = pm.sharded_reduce_mul_fixed, pm.sharded_pow_mod
+
+    def spy_reduce(*a, **k):
+        calls["reduce"] += 1
+        return orig_reduce(*a, **k)
+
+    def spy_pow(*a, **k):
+        calls["pow"] += 1
+        return orig_pow(*a, **k)
+
+    monkeypatch.setattr(pm, "sharded_reduce_mul_fixed", spy_reduce)
+    monkeypatch.setattr(pm, "sharded_pow_mod", spy_pow)
+
+    n = rng.getrandbits(512) | (1 << 511) | 1
+    be = TpuBackend(pallas=False, min_device_batch=0, mesh=make_mesh(4))
+    cs = [rng.randrange(n) for _ in range(19)]
+    want = 1
+    for c in cs:
+        want = want * c % n
+    assert be.modmul_fold(cs, n) == want
+    assert calls["reduce"] == 1
+
+    bases = [rng.randrange(n) for _ in range(7)]  # not divisible by 4: pads
+    assert be.powmod_batch(bases, 65537, n) == [pow(b, 65537, n) for b in bases]
+    assert calls["pow"] == 1
+
+
+def test_dds_mesh_env_builds_mesh_lazily(monkeypatch):
+    from dds_tpu.models.backend import TpuBackend
+
+    monkeypatch.setenv("DDS_MESH", "4")
+    be = TpuBackend(pallas=False, min_device_batch=0)
+    assert be.mesh is None  # not built yet
+    n = rng.getrandbits(512) | (1 << 511) | 1
+    cs = [rng.randrange(n) for _ in range(8)]
+    want = 1
+    for c in cs:
+        want = want * c % n
+    assert be.modmul_fold(cs, n) == want
+    assert be.mesh is not None and be.mesh.devices.size == 4

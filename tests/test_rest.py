@@ -346,3 +346,39 @@ def test_removeset_stops_aggregation():
             assert json.loads(data)["result"] == "7"
 
     asyncio.run(go())
+
+
+def test_sumall_executes_sharded_on_mesh(monkeypatch):
+    """End-to-end §5.7: a proxy `SumAll` on a 4-device mesh runs the fold
+    through the sharded kernel and still decrypts correctly."""
+    from dds_tpu.models.backend import TpuBackend
+    from dds_tpu.parallel import mesh as pm
+    from dds_tpu.parallel.mesh import make_mesh
+
+    calls = {"n": 0}
+    orig = pm.sharded_reduce_mul_fixed
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(pm, "sharded_reduce_mul_fixed", spy)
+
+    async def go():
+        async with rest_stack() as (server, _, _):
+            server.backend = TpuBackend(
+                pallas=False, min_device_batch=0, mesh=make_mesh(4)
+            )
+            pk = PROVIDER.keys.psse.public
+            vals = [7, 8, 9, 10, 11]
+            for v in vals:
+                row = PROVIDER.encrypt_row([v], 1, ["PSSE"])
+                await call(server, "POST", "/PutSet", {"contents": row})
+            _, data = await call(
+                server, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}"
+            )
+            got = PROVIDER.keys.psse.decrypt(int(json.loads(data)["result"]))
+            assert got == sum(vals)
+            assert calls["n"] >= 1  # the fold actually went through the mesh
+
+    asyncio.run(go())

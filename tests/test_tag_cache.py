@@ -15,8 +15,8 @@ from dds_tpu.core import messages as M
 from dds_tpu.core.errors import ByzantineError
 from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
 
-from test_core import Cluster, run
-from test_rest import PROVIDER, call, rest_stack
+from tests.test_core import Cluster, run
+from tests.test_rest import PROVIDER, call, rest_stack
 
 
 # ------------------------------------------------------------ protocol level
