@@ -80,8 +80,8 @@ class AbdClient:
                 return
         log.debug("unmatched message from %s: %s", sender, type(msg).__name__)
 
-    async def _ask(self, call, nonce: int, signature: bytes):
-        coordinator = self.replicas.defer_to()
+    async def _ask(self, call, nonce: int, signature: bytes, exclude=()):
+        coordinator = self.replicas.defer_to(exclude)
         challenge = nonce + self.cfg.nonce_increment
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[challenge] = (fut, coordinator)
@@ -103,10 +103,20 @@ class AbdClient:
     async def fetch_set_tagged(self, key: str):
         """Quorum read; returns (set|None, tag) — the tag of the value the
         coordinator wrote back, for tag-validated caching."""
+        value, tag, _ = await self.fetch_set_attributed(key)
+        return value, tag
+
+    async def fetch_set_attributed(self, key: str, exclude=()):
+        """Quorum read; returns (set|None, tag, coordinator). `exclude`
+        steers coordinator choice away from given nodes so an audit's
+        corroborating re-read goes through a different coordinator than
+        the read it is checking."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce)
         with tracer.span("abd.fetch"):
-            reply, coord, challenge = await self._ask(M.IRead(key), nonce, sig)
+            reply, coord, challenge = await self._ask(
+                M.IRead(key), nonce, sig, exclude
+            )
 
         cfg = self.cfg
         match reply:
@@ -122,7 +132,7 @@ class AbdClient:
                 if k != key:
                     self.replicas.increment_suspicion(coord)
                     raise ByzInvalidKeyError(coord)
-                return value, tag
+                return value, tag, coord
             case _:
                 self.replicas.increment_suspicion(coord)
                 raise ByzUnknownReplyError(coord)

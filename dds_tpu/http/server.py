@@ -155,14 +155,17 @@ class DDSRestServer:
         if cur is None or cur[0] < tag:
             self._cache[key] = (tag, value)
 
-    async def _fetch(self, key: str):
-        value, tag = await retry(
-            lambda: self.abd.fetch_set_tagged(key),
+    async def _fetch_tagged(self, key: str, exclude=()):
+        value, tag, coord = await retry(
+            lambda: self.abd.fetch_set_attributed(key, exclude),
             self.cfg.retry_backoff,
             self.cfg.retry_attempts,
         )
         self._cache_put(key, tag, value)
-        return value
+        return value, tag, coord
+
+    async def _fetch(self, key: str):
+        return (await self._fetch_tagged(key))[0]
 
     async def _write(self, key: str, value):
         k, tag = await retry(
@@ -196,6 +199,7 @@ class DDSRestServer:
         if not keys:
             return []
         fresh: dict[str, object] = {}
+        fresh_tags: dict[str, object] = {}
         cached = [k for k in keys if k in self._cache]
         if self.cfg.aggregate_cache and cached:
             try:
@@ -208,25 +212,54 @@ class DDSRestServer:
                     ct, cv = self._cache[k]
                     if t == ct:
                         fresh[k] = cv
+                        fresh_tags[k] = ct
             except Exception as e:  # validation trouble => plain full fetch
                 log.debug("tag validation failed (%s); full refetch", e)
 
         # audit sample: re-read a few cache-served keys through a full
-        # quorum under a (random) coordinator; a mismatch means some past
-        # coordinator forged a cached value — flush everything
+        # quorum under a (random) coordinator. A value mismatch at the SAME
+        # tag means some past coordinator forged a cached value — flush
+        # everything. A mismatch at a strictly NEWER tag is usually a benign
+        # write that landed between the tag-validation round and the audit
+        # re-read — but the newer tag is reported by the audited read
+        # itself, so it is corroborated by an independent re-read before
+        # being exempted from the flush.
         audit = random.sample(
             sorted(fresh), min(self.cfg.aggregate_cache_audit, len(fresh))
         )
         stale = [k for k in keys if k not in fresh or k in audit]
         results = await asyncio.gather(
-            *(self._fetch(k) for k in stale), return_exceptions=True
+            *(self._fetch_tagged(k) for k in stale), return_exceptions=True
         )
-        fetched = {}
+        fetched, fetched_tags, fetched_coord = {}, {}, {}
         for k, r in zip(stale, results):
             if isinstance(r, Exception):
                 raise r
-            fetched[k] = r
-        if any(fetched[k] != fresh[k] for k in audit):
+            fetched[k], fetched_tags[k], fetched_coord[k] = r
+        forged, suspect = [], []
+        for k in audit:
+            if fetched[k] == fresh[k]:
+                continue
+            if fetched_tags[k] is None or fetched_tags[k] <= fresh_tags[k]:
+                forged.append(k)
+            else:
+                suspect.append(k)
+        # A newer-tag mismatch is usually benign, but the newer tag came
+        # from the very read being audited, so it is attacker-controllable:
+        # corroborate each with ONE more full quorum read through a
+        # DIFFERENT coordinator (the audited read's is excluded). Benign
+        # only if that independent read reproduces the same (value, tag);
+        # a failed corroboration degrades to the conservative flush rather
+        # than failing the aggregate.
+        if suspect:
+            checks = await asyncio.gather(
+                *(self._fetch_tagged(k, exclude=(fetched_coord[k],)) for k in suspect),
+                return_exceptions=True,
+            )
+            for k, r in zip(suspect, checks):
+                if isinstance(r, Exception) or r[:2] != (fetched[k], fetched_tags[k]):
+                    forged.append(k)
+        if forged:
             log.warning("aggregate cache audit mismatch: flushing cache")
             self._cache.clear()
             fresh.clear()  # serve only quorum-read data this round

@@ -20,6 +20,7 @@ Works identically on a real TPU slice and on the test fabric
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -46,12 +47,16 @@ def make_mesh(n_devices: int | None = None, axis: str = "batch") -> Mesh:
 # executable — unbounded growth would be a client-driven memory/compile DoS.
 _FN_CACHE: dict = {}
 _FN_CACHE_MAX = 64
+# folds are dispatched from proxy worker threads (asyncio.to_thread), so
+# eviction + insert must be atomic or two threads can pop the same FIFO key
+_FN_CACHE_LOCK = threading.Lock()
 
 
 def _fn_cache_put(key, fn) -> None:
-    while len(_FN_CACHE) >= _FN_CACHE_MAX:
-        _FN_CACHE.pop(next(iter(_FN_CACHE)))
-    _FN_CACHE[key] = fn
+    with _FN_CACHE_LOCK:
+        while len(_FN_CACHE) >= _FN_CACHE_MAX:
+            _FN_CACHE.pop(next(iter(_FN_CACHE)), None)
+        _FN_CACHE[key] = fn
 
 
 def _tree_reduce_local(cs, N, n0inv, one_mont):

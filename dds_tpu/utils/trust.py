@@ -32,8 +32,12 @@ class TrustedNodesList:
         """Replace the membership, keeping strikes of surviving nodes."""
         self._strikes = {n: self._strikes.get(n, 0) for n in nodes}
 
-    def defer_to(self) -> str:
+    def defer_to(self, exclude=()) -> str:
+        """Pick a random trusted node, avoiding `exclude` when any other
+        trusted node remains (used to pick a genuinely different
+        coordinator for corroborating re-reads)."""
         trusted = self.get_trusted()
         if not trusted:
             raise RuntimeError("no trusted nodes left")
-        return self._rng.choice(trusted)
+        preferred = [n for n in trusted if n not in exclude]
+        return self._rng.choice(preferred or trusted)

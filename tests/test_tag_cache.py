@@ -102,24 +102,37 @@ def test_crafted_column_values_stay_opaque():
     assert M.loads(M.dumps(env)) == env
 
 
+def test_defer_to_exclusion_picks_a_different_coordinator():
+    """The audit's corroborating re-read must not land on the coordinator
+    it is checking: defer_to(exclude) avoids it whenever another trusted
+    node exists, and only falls back when no alternative remains."""
+    from dds_tpu.utils.trust import TrustedNodesList
+
+    t = TrustedNodesList(["a", "b", "c"])
+    assert all(t.defer_to(exclude=("a",)) != "a" for _ in range(50))
+    t2 = TrustedNodesList(["a"])
+    assert t2.defer_to(exclude=("a",)) == "a"  # fallback, not a crash
+
+
 # --------------------------------------------------------------- proxy level
 
 def _count_fetches(server):
     """Wrap the proxy's quorum read so tests can count full ABD fetches."""
     counter = {"n": 0}
-    orig = server.abd.fetch_set_tagged
+    orig = server.abd.fetch_set_attributed
 
-    async def counted(key):
+    async def counted(key, exclude=()):
         counter["n"] += 1
-        return await orig(key)
+        return await orig(key, exclude)
 
-    server.abd.fetch_set_tagged = counted
+    server.abd.fetch_set_attributed = counted
     return counter
 
 
 def test_aggregate_cache_serves_warm_and_sees_external_writes():
     async def go():
         async with rest_stack() as (server, replicas, _):
+            server.cfg.aggregate_cache_audit = 0  # counting pure cache hits
             pk = PROVIDER.keys.psse.public
             vals = [11, 22, 33]
             keys = []
@@ -152,6 +165,103 @@ def test_aggregate_cache_serves_warm_and_sees_external_writes():
             # steady state again: all fresh, no fetches
             _, data = await call(server, "GET", target)
             assert counter["n"] == 1
+
+    asyncio.run(go())
+
+
+def test_audit_costs_exactly_sample_size_fetches():
+    """The audit's own cost is pinned: a warm aggregate performs exactly
+    min(aggregate_cache_audit, cached-keys) full quorum reads — no more."""
+
+    async def go():
+        async with rest_stack() as (server, _, _):
+            pk = PROVIDER.keys.psse.public
+            vals = [1, 2, 3]
+            for v in vals:
+                row = PROVIDER.encrypt_row([v], 1, ["PSSE"])
+                await call(server, "POST", "/PutSet", {"contents": row})
+            counter = _count_fetches(server)
+            target = f"/SumAll?position=0&nsqr={pk.nsquare}"
+            assert server.cfg.aggregate_cache_audit == 2  # default under test
+            for i in (1, 2):
+                _, data = await call(server, "GET", target)
+                assert (
+                    PROVIDER.keys.psse.decrypt(int(json.loads(data)["result"]))
+                    == sum(vals)
+                )
+                assert counter["n"] == 2 * i
+
+    asyncio.run(go())
+
+
+def test_audit_detects_forged_cache_entry_and_flushes():
+    """A forged cached value at the TRUE tag (what a Byzantine coordinator
+    holding the proxy MAC secret could plant) is caught by the audit: the
+    re-read mismatches at the SAME tag, the cache is flushed, and the
+    aggregate is computed from quorum reads only."""
+
+    async def go():
+        async with rest_stack() as (server, _, _):
+            pk = PROVIDER.keys.psse.public
+            vals = [11, 22, 33]
+            keys = []
+            for v in vals:
+                row = PROVIDER.encrypt_row([v], 1, ["PSSE"])
+                _, key = await call(server, "POST", "/PutSet", {"contents": row})
+                keys.append(key.decode())
+            # audit the whole cache so the poisoned key is sampled for sure
+            server.cfg.aggregate_cache_audit = len(keys)
+            tag, _ = server._cache[keys[0]]
+            forged_row = PROVIDER.encrypt_row([999], 1, ["PSSE"])
+            server._cache[keys[0]] = (tag, forged_row)
+
+            target = f"/SumAll?position=0&nsqr={pk.nsquare}"
+            _, data = await call(server, "GET", target)
+            got = PROVIDER.keys.psse.decrypt(int(json.loads(data)["result"]))
+            assert got == sum(vals)  # forgery did not reach the result
+            # flush: every pre-flush entry (incl. audit refills) was dropped
+            assert server._cache == {}
+
+    asyncio.run(go())
+
+
+def test_audit_benign_concurrent_write_refreshes_without_flush():
+    """A write landing between the tag-validation round and the audit
+    re-read mismatches at a strictly NEWER tag — the audit must refresh
+    that entry and serve the new value, not flush the whole cache."""
+
+    async def go():
+        async with rest_stack() as (server, replicas, _):
+            pk = PROVIDER.keys.psse.public
+            vals = [11, 22, 33]
+            keys = []
+            for v in vals:
+                row = PROVIDER.encrypt_row([v], 1, ["PSSE"])
+                _, key = await call(server, "POST", "/PutSet", {"contents": row})
+                keys.append(key.decode())
+            server.cfg.aggregate_cache_audit = len(keys)
+
+            # freeze the validation round at the pre-write tags, simulating
+            # the race where read_tags completes just before the write lands
+            stale_tags = {k: server._cache[k][0] for k in keys}
+
+            async def frozen_read_tags(ks):
+                return [stale_tags[k] for k in ks]
+
+            server.abd.read_tags = frozen_read_tags
+            other = AbdClient(
+                "proxy-ext3", server.abd.net, list(replicas),
+                AbdClientConfig(request_timeout=2.0),
+            )
+            await other.write_set(keys[0], PROVIDER.encrypt_row([100], 1, ["PSSE"]))
+
+            target = f"/SumAll?position=0&nsqr={pk.nsquare}"
+            _, data = await call(server, "GET", target)
+            got = PROVIDER.keys.psse.decrypt(int(json.loads(data)["result"]))
+            assert got == 100 + 22 + 33  # the audit's newer value is served
+            # no flush: all keys still cached, bumped key at its new tag
+            assert set(server._cache) == set(keys)
+            assert server._cache[keys[0]][0] > stale_tags[keys[0]]
 
     asyncio.run(go())
 
