@@ -47,9 +47,14 @@ class IReadReply:
     key: str
     set: Optional[DDSSet]
     # tag of the returned value (the write-back tag). Lets the proxy keep a
-    # tag-validated aggregate cache; NOT covered by the proxy HMAC (the
-    # coordinator computes that HMAC anyway — a lying tag can only cause a
-    # spurious re-fetch, never a stale serve, see http/server.py cache notes).
+    # tag-validated aggregate cache. Covered by the proxy HMAC (tags are
+    # predictable, so an unsigned tag could be swapped in transit). Cache
+    # VALIDATION does not trust this field or its (single, possibly
+    # Byzantine) coordinator at all — freshness comes from the proxy's own
+    # quorum tag broadcast (AbdClient.read_tags), which a minority can only
+    # inflate (spurious re-fetch), never deflate (stale serve); forged
+    # VALUES from a Byzantine coordinator are bounded by the cache audit
+    # (see http/server.py cache notes).
     tag: Optional[ABDTag] = None
 
 
@@ -57,23 +62,6 @@ class IReadReply:
 class IWriteReply:
     key: str
     tag: Optional[ABDTag] = None  # the tag the coordinator wrote (see above)
-
-
-@dataclass(frozen=True)
-class ITagRead:
-    """Batched freshness probe: current max tag for each key, via ONE quorum
-    round of small tag-only messages (no set contents travel). This is the
-    aggregate-cache validation op the reference lacks — it re-reads every
-    stored set through full ABD quorums per aggregate instead
-    (`dds/http/DDSRestServer.scala:397-446`)."""
-
-    keys: tuple
-
-
-@dataclass(frozen=True)
-class ITagReply:
-    digest: str   # SHA-512 over the requested key list (echo check)
-    tags: tuple   # ABDTag per requested key, same order
 
 
 @dataclass(frozen=True)
@@ -126,10 +114,17 @@ class Read:
 @dataclass(frozen=True)
 class ReadTagBatch:
     """Tag-phase-only quorum read over many keys at once (no Write phase
-    follows). Replies carry tags, never contents."""
+    follows), broadcast by the PROXY itself (AbdClient.read_tags) so no
+    single coordinator can deflate the max. Replies carry tags, never
+    contents. `signature` is the proxy MAC over (keys-digest, nonce):
+    replicas answer (and burn an anti-replay nonce) only for holders of
+    the proxy secret. This is the aggregate-cache validation op the
+    reference lacks — it re-reads every stored set through full ABD
+    quorums per aggregate instead (`dds/http/DDSRestServer.scala:397-446`)."""
 
     keys: tuple
     nonce: int
+    signature: bytes = b""
 
 
 @dataclass(frozen=True)
@@ -217,7 +212,7 @@ class Compromise:
 _TYPES = {
     cls.__name__: cls
     for cls in (
-        IRead, IWrite, IReadReply, IWriteReply, ITagRead, ITagReply, Envelope,
+        IRead, IWrite, IReadReply, IWriteReply, Envelope,
         ReadTag, TagReply, Write, WriteAck, Read, ReadReply,
         ReadTagBatch, TagBatchReply,
         Suspect, Awake, State, Sleep, Complying, Kill,

@@ -41,6 +41,12 @@ class AbdClientConfig:
     nonce_increment: int = 1
     request_timeout: float = 5.0
     supervisor: str | None = None  # only accept ActiveReplicas from here
+    # read_tags broadcasts ReadTagBatch to the replicas itself and verifies
+    # each reply's intranet MAC, so it needs the ABD secret + quorum size
+    # (the proxy lives inside the intranet in the reference too —
+    # `dds-system.conf:94` puts both secrets in the one shared config)
+    abd_mac_secret: bytes = b"intranet-abd-secret"
+    quorum_size: int = 5
 
 
 class AbdClient:
@@ -57,6 +63,8 @@ class AbdClient:
         self.replicas = TrustedNodesList(replicas)
         # challenge nonce -> (future, coordinator)
         self._pending: dict[int, tuple[asyncio.Future, str]] = {}
+        # tag-broadcast nonce -> (future, sender->tags votes, digest, keys)
+        self._pending_tags: dict[int, tuple] = {}
         net.register(addr, self.handle)
 
     async def handle(self, sender: str, msg) -> None:
@@ -64,6 +72,9 @@ class AbdClient:
             fut, _ = self._pending[msg.nonce]
             if not fut.done():
                 fut.set_result(msg)
+            return
+        if isinstance(msg, M.TagBatchReply) and msg.nonce in self._pending_tags:
+            self._on_tag_batch_reply(sender, msg)
             return
         if isinstance(msg, M.ActiveReplicas):
             if self.cfg.supervisor is not None and sender != self.cfg.supervisor:
@@ -125,7 +136,8 @@ class AbdClient:
                     self.replicas.increment_suspicion(coord)
                     raise ByzFailedNonceChallengeError(coord)
                 if not sigs.validate_proxy_signature(
-                    cfg.proxy_mac_secret, k, rnonce, rsig, value
+                    cfg.proxy_mac_secret, k, rnonce, rsig,
+                    [value, sigs.tag_payload(tag)],
                 ):
                     self.replicas.increment_suspicion(coord)
                     raise ByzInvalidSignatureError(coord)
@@ -154,7 +166,9 @@ class AbdClient:
                 if rnonce != challenge:
                     self.replicas.increment_suspicion(coord)
                     raise ByzFailedNonceChallengeError(coord)
-                if not sigs.validate_proxy_signature(cfg.proxy_mac_secret, k, rnonce, rsig):
+                if not sigs.validate_proxy_signature(
+                    cfg.proxy_mac_secret, k, rnonce, rsig, sigs.tag_payload(tag)
+                ):
                     self.replicas.increment_suspicion(coord)
                     raise ByzInvalidSignatureError(coord)
                 if k != key:
@@ -165,38 +179,60 @@ class AbdClient:
                 self.replicas.increment_suspicion(coord)
                 raise ByzUnknownReplyError(coord)
 
+    def _on_tag_batch_reply(self, sender: str, msg: M.TagBatchReply) -> None:
+        fut, votes, digest, keys = self._pending_tags[msg.nonce]
+        if fut.done() or sender in votes:
+            return
+        if (
+            msg.digest != digest
+            or len(msg.tags) != len(keys)
+            or not sigs.validate_abd_batch_signature(
+                self.cfg.abd_mac_secret, msg.tags, msg.digest, msg.nonce,
+                msg.signature,
+            )
+        ):
+            self.replicas.increment_suspicion(sender)
+            return
+        votes[sender] = tuple(msg.tags)
+        if len(votes) >= self.cfg.quorum_size:
+            fut.set_result(list(votes.values()))
+
     async def read_tags(self, keys: list[str]) -> list[M.ABDTag]:
         """Batched freshness probe: the quorum-max tag per key via ONE
-        tag-only quorum round (`ITagRead` -> `ReadTagBatch` fan-out). Cheap
-        because no set contents travel — the cache-validation primitive
-        behind the proxy's aggregate cache."""
+        tag-only round broadcast by the proxy ITSELF — `ReadTagBatch` fans
+        out to every trusted replica, each reply's intranet MAC is verified
+        here, and the per-key max is taken over the first `quorum_size`
+        valid reply vectors. No single coordinator is trusted: any quorum
+        intersects a completed write's quorum in an honest replica, so the
+        max can never be deflated below the newest completed write's tag —
+        a lying replica can only inflate it, forcing a spurious re-fetch,
+        never a stale serve. That argument keys votes by SENDER, so it is
+        only as strong as the transport's sender authenticity: in-process
+        delivery (InMemoryNet) or per-node mutual TLS on TcpNet; a shared
+        frame secret alone does not stop a credentialed replica from
+        stuffing the vote with spoofed senders. Cheap because no set
+        contents travel — the cache-validation primitive behind the
+        proxy's aggregate cache."""
+        trusted = self.replicas.get_trusted()
+        if len(trusted) < self.cfg.quorum_size:
+            raise ByzUnknownReplyError(
+                f"only {len(trusted)} trusted replicas < quorum {self.cfg.quorum_size}"
+            )
         nonce = sigs.generate_nonce()
         digest = sigs.key_from_set(list(keys))
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, digest, nonce)
-        with tracer.span("abd.read_tags", k=len(keys)):
-            reply, coord, challenge = await self._ask(
-                M.ITagRead(tuple(keys)), nonce, sig
-            )
-
-        cfg = self.cfg
-        match reply:
-            case M.Envelope(M.ITagReply(rdigest, tags), rnonce, rsig):
-                if rnonce != challenge:
-                    self.replicas.increment_suspicion(coord)
-                    raise ByzFailedNonceChallengeError(coord)
-                if not sigs.validate_proxy_signature(
-                    cfg.proxy_mac_secret, rdigest, rnonce, rsig,
-                    sigs.tags_payload(tags),
-                ):
-                    self.replicas.increment_suspicion(coord)
-                    raise ByzInvalidSignatureError(coord)
-                if rdigest != digest or len(tags) != len(keys):
-                    self.replicas.increment_suspicion(coord)
-                    raise ByzInvalidKeyError(coord)
-                return list(tags)
-            case _:
-                self.replicas.increment_suspicion(coord)
-                raise ByzUnknownReplyError(coord)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending_tags[nonce] = (fut, {}, digest, tuple(keys))
+        try:
+            with tracer.span("abd.read_tags", k=len(keys)):
+                for replica in trusted:
+                    self.net.send(
+                        self.addr, replica, M.ReadTagBatch(tuple(keys), nonce, sig)
+                    )
+                vectors = await asyncio.wait_for(fut, self.cfg.request_timeout)
+            return [max(col) for col in zip(*vectors)] if keys else []
+        finally:
+            self._pending_tags.pop(nonce, None)
 
     def refresh_from(self, supervisor: str) -> None:
         """Ask the supervisor for the freshest active replicas (fire & forget;

@@ -60,7 +60,6 @@ class _Outgoing:
     set_to_read: object = None
     set_to_write: object = None
     tag_to_reply: object = None  # tag returned to the proxy (read max / written)
-    keys_digest: str = ""        # ITagRead: SHA-512 over keys, computed once
 
 
 class BFTABDNode:
@@ -150,15 +149,6 @@ class BFTABDNode:
                         else:
                             req.set_to_write = value
                             self._broadcast(M.ReadTag(key, nonce))
-                    case M.ITagRead(keys):
-                        digest = sigs.key_from_set(list(keys))
-                        if not sigs.validate_proxy_signature(
-                            cfg.proxy_mac_secret, digest, nonce, signature
-                        ):
-                            self._debug("invalid proxy signature (tag read)")
-                        else:
-                            req.keys_digest = digest
-                            self._broadcast(M.ReadTagBatch(tuple(keys), nonce))
                     case _:
                         log.error("unexpected API call from proxy: %r", call)
                 self.outgoing[nonce] = req
@@ -173,7 +163,18 @@ class BFTABDNode:
                 sig = sigs.abd_signature(cfg.abd_mac_secret, contents, tag, nonce)
                 self._send(sender, M.TagReply(tag, key, contents, sig, nonce))
 
-            case M.ReadTagBatch(keys, nonce):
+            case M.ReadTagBatch(keys, nonce, psig):
+                # sent straight by the proxy (AbdClient.read_tags), not by a
+                # coordinator: authenticate the request BEFORE burning an
+                # anti-replay nonce, or unauthenticated traffic could both
+                # enumerate tags (write-activity oracle) and grow the nonce
+                # set without bound
+                digest = sigs.key_from_set(list(keys))
+                if not sigs.validate_proxy_signature(
+                    cfg.proxy_mac_secret, digest, nonce, psig
+                ):
+                    self._debug("invalid proxy signature (tag batch)")
+                    return
                 if nonce in self.incoming:
                     self._debug("invalid nonce - repeated (tag batch)")
                     self._suspect(sender)
@@ -183,52 +184,8 @@ class BFTABDNode:
                 # read without materializing default entries in the repository
                 blank = (M.ABDTag(0, self.name), None)
                 tags = tuple(self.repository.get(k, blank)[0] for k in keys)
-                digest = sigs.key_from_set(list(keys))
                 sig = sigs.abd_batch_signature(cfg.abd_mac_secret, tags, digest, nonce)
                 self._send(sender, M.TagBatchReply(tags, digest, sig, nonce))
-
-            case M.TagBatchReply(tags, digest, signature, nonce):
-                if not sigs.validate_abd_batch_signature(
-                    cfg.abd_mac_secret, tags, digest, nonce, signature
-                ):
-                    self._debug("invalid ABD batch signature")
-                    self._suspect(sender)
-                    return
-                req = self.outgoing.get(nonce)
-                if req is None:
-                    self._debug("invalid nonce - unknown (tag batch)")
-                    self._suspect(sender)
-                    return
-                if req.expired:
-                    self._debug("invalid nonce - expired (late tag batch reply)")
-                    return
-                if not isinstance(req.call, M.ITagRead):
-                    self._debug("TagBatchReply for a non-tag-read request")
-                    self._suspect(sender)
-                    return
-                keys = tuple(req.call.keys)
-                if len(tags) != len(keys):
-                    self._debug("tag batch reply has wrong arity")
-                    self._suspect(sender)
-                    return
-                req.read_quorum[sender] = tuple(tags)
-                if len(req.read_quorum) >= cfg.quorum_size:
-                    vectors = list(req.read_quorum.values())
-                    req.read_quorum = {}
-                    req.expired = True
-                    max_tags = tuple(max(col) for col in zip(*vectors)) if keys else ()
-                    challenge = req.client_nonce + cfg.nonce_increment
-                    reply_digest = req.keys_digest
-                    psig = sigs.proxy_signature(
-                        cfg.proxy_mac_secret,
-                        reply_digest,
-                        challenge,
-                        sigs.tags_payload(max_tags),
-                    )
-                    self._send(
-                        req.client,
-                        M.Envelope(M.ITagReply(reply_digest, max_tags), challenge, psig),
-                    )
 
             case M.TagReply(tag, key, value, signature, nonce):
                 if not sigs.validate_abd_signature(
@@ -304,8 +261,15 @@ class BFTABDNode:
                     challenge = req.client_nonce + cfg.nonce_increment
                     match req.call:
                         case M.IRead(k):
+                            # the MAC covers the tag too: tags are
+                            # predictable, so an unsigned tag could be
+                            # swapped in transit to poison tag-validated
+                            # caching at the proxy
                             sig = sigs.proxy_signature(
-                                cfg.proxy_mac_secret, k, challenge, req.set_to_read
+                                cfg.proxy_mac_secret,
+                                k,
+                                challenge,
+                                [req.set_to_read, sigs.tag_payload(req.tag_to_reply)],
                             )
                             self._send(
                                 req.client,
@@ -318,7 +282,12 @@ class BFTABDNode:
                                 ),
                             )
                         case M.IWrite(k, _):
-                            sig = sigs.proxy_signature(cfg.proxy_mac_secret, k, challenge)
+                            sig = sigs.proxy_signature(
+                                cfg.proxy_mac_secret,
+                                k,
+                                challenge,
+                                sigs.tag_payload(req.tag_to_reply),
+                            )
                             self._send(
                                 req.client,
                                 M.Envelope(
@@ -448,10 +417,10 @@ class BFTABDNode:
                         M.TagReply(M.ABDTag(0, self.name), key, garbage, b"", nonce),
                     )
 
-            case M.ReadTagBatch(keys, nonce):
-                # inflated tags under an empty signature, replayed x2: an
-                # honest coordinator drops these on MAC failure; even if the
-                # tags landed they could only force spurious cache re-fetches
+            case M.ReadTagBatch(keys, nonce, _):
+                # inflated tags under an empty signature, replayed x2: the
+                # proxy drops these on MAC failure; even if the tags landed
+                # they could only force spurious cache re-fetches
                 fake = tuple(M.ABDTag(1 << 30, self.name) for _ in keys)
                 for _ in range(2):
                     self._send(sender, M.TagBatchReply(fake, "forged", b"", nonce))

@@ -55,10 +55,18 @@ class ProxyConfig:
     # (`DDSRestServer.scala:397-446` re-reads every set, cache-less).
     aggregate_cache: bool = True
     # per-aggregate audit sample: this many cache-served keys are also
-    # re-read through a full quorum (random coordinator); any mismatch
-    # flushes the cache. Bounds how long a Byzantine COORDINATOR's forgery
-    # (valid proxy HMAC over a forged value + the true tag) can persist —
-    # without the audit a forged entry would keep validating by tag alone.
+    # re-read through a full quorum (random coordinator); any
+    # non-corroborated mismatch flushes the cache. Bounds how long a
+    # Byzantine COORDINATOR's forgery (valid proxy HMAC over a forged
+    # value + the true tag) can persist — without the audit a forged entry
+    # would keep validating by tag alone. The bound is probabilistic, and
+    # deliberately so: full reads trust a single random coordinator, as the
+    # reference's do (`DDSRestServer.scala:952-1000`), so a coordinator
+    # holding the proxy secret can always poison the ONE read it serves;
+    # what the cache must not add is *persistence*. Even with f colluding
+    # coordinators defeating one corroboration round, a forged entry
+    # survives future audits only until one samples it through an honest
+    # coordinator — expected ~K/(2*audit) aggregate rounds at K cached keys.
     aggregate_cache_audit: int = 2
     # proxy->proxy key gossip (DDSRestServer.scala:118-136)
     key_sync_enabled: bool = False

@@ -58,29 +58,43 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     stoppables = []
 
     # mutual TLS on the HTTP hops (SURVEY §2.14/§2.20 posture, configurable)
+    sec = cfg.security
     ssl_server = ssl_client = None
-    if cfg.security.tls_enabled:
+    intranet_server = intranet_client = None
+    if sec.tls_enabled or sec.intranet_tls_enabled:
         from dds_tpu.utils import tlsutil
 
-        sec = cfg.security
         if sec.tls_ca and sec.tls_cert and sec.tls_key:
             ca, cert, key = sec.tls_ca, sec.tls_cert, sec.tls_key
         else:
             # dev fallback: per-node CA — single-host only (see SecurityConfig)
             paths = tlsutil.generate_ca_and_cert(
-                sec.tls_dir, hosts=(cfg.proxy.host, "localhost")
+                sec.tls_dir,
+                hosts=(cfg.proxy.host, cfg.transport.host, "localhost"),
             )
             ca, cert, key = paths["ca"], paths["cert"], paths["key"]
-        ssl_server = tlsutil.server_context(cert, key, ca)
-        ssl_client = tlsutil.client_context(
-            ca, cert, key, verify_hostname=sec.tls_verify_hostname
-        )
+        if sec.tls_enabled:
+            ssl_server = tlsutil.server_context(cert, key, ca)
+            ssl_client = tlsutil.client_context(
+                ca, cert, key, verify_hostname=sec.tls_verify_hostname
+            )
+        if sec.intranet_tls_enabled:
+            # replica fabric mutual TLS — the netty-SSL intranet of the
+            # reference (`dds-system.conf:18-58`): every hop presents a
+            # CA-signed cert in both directions, giving the sender-keyed
+            # quorum votes transport-level authenticity on top of frame MACs
+            intranet_server = tlsutil.server_context(cert, key, ca)
+            intranet_client = tlsutil.client_context(
+                ca, cert, key, verify_hostname=sec.tls_verify_hostname
+            )
 
     # transport fabric (SURVEY.md §5.8: control plane stays on CPU/asyncio)
     if cfg.transport.kind == "tcp":
         net = TcpNet(
             cfg.transport.host,
             cfg.transport.port,
+            ssl_server=intranet_server,
+            ssl_client=intranet_client,
             frame_secret=cfg.security.transport_frame_secret.encode() or None,
         )
         await net.start()
@@ -144,6 +158,8 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             proxy_mac_secret=cfg.security.proxy_mac_secret.encode(),
             nonce_increment=cfg.security.nonce_challenge_increment,
             request_timeout=cfg.proxy.intranet_request_timeout,
+            abd_mac_secret=cfg.security.abd_mac_secret.encode(),
+            quorum_size=cfg.replicas.byz_quorum_size,
         ),
     )
     server = DDSRestServer(

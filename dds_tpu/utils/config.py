@@ -39,6 +39,10 @@ class SecurityConfig:
     # dev CA auto-generates under tls_dir (single-host only — two nodes
     # with independent CAs cannot verify each other).
     tls_enabled: bool = False
+    # mutual TLS on the replica/supervisor TCP fabric (the reference's
+    # netty-SSL intranet, dds-system.conf:18-58). Shares the tls_* material
+    # below; only meaningful with transport.kind = "tcp".
+    intranet_tls_enabled: bool = False
     tls_dir: str = "certs"
     tls_ca: str = ""
     tls_cert: str = ""

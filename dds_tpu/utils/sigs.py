@@ -53,6 +53,15 @@ def validate_abd_signature(secret: bytes, value, tag, nonce: int, given: bytes) 
     return hmac.compare_digest(abd_signature(secret, value, tag, nonce), given)
 
 
+def tag_payload(tag):
+    """Canonical JSON-safe form of one tag for signing: [seq, id] (None
+    stays None). Tags are predictable (seq, coordinator-id), so reply
+    MACs must cover them — otherwise an in-transit attacker could swap a
+    guessed future tag and later turn the proxy's tag-validated cache
+    into a stale serve."""
+    return None if tag is None else [tag.seq, tag.id]
+
+
 def tags_payload(tags) -> list:
     """Canonical JSON-safe form of a tag vector for signing: [[seq, id], ...].
     Both the replica (signer) and proxy (verifier) derive this from their own

@@ -17,7 +17,12 @@ class TrustedNodesList:
         self._rng = rng or random.Random()
 
     def increment_suspicion(self, node: str) -> None:
-        self._strikes[node] = self._strikes.get(node, 0) + 1
+        """Strike a MEMBER. Unknown names are ignored: striking would
+        insert them into the membership with < limit strikes, so any
+        unauthenticated message with a crafted sender could inject itself
+        into the trusted set (and get picked as a coordinator)."""
+        if node in self._strikes:
+            self._strikes[node] += 1
 
     def get_untrusted(self) -> list[str]:
         return [n for n, s in self._strikes.items() if s >= STRIKE_LIMIT]

@@ -312,6 +312,84 @@ def test_tcp_frame_mac_rejects_spoofed_frames():
     run(go())
 
 
+def test_tcp_intranet_mutual_tls_rejects_certless_peer(tmp_path):
+    """The replica fabric under mutual TLS (`dds-system.conf:18-58`): a
+    certified peer's frames arrive; a peer that completes TCP but presents
+    no client certificate fails the handshake and delivers nothing."""
+
+    async def go():
+        import ssl as _ssl
+
+        from dds_tpu.core.transport import TcpNet
+        from dds_tpu.utils import tlsutil
+
+        paths = tlsutil.generate_ca_and_cert(tmp_path, hosts=("127.0.0.1",))
+        ca, cert, key = paths["ca"], paths["cert"], paths["key"]
+        server_ctx = tlsutil.server_context(cert, key, ca)
+        client_ctx = tlsutil.client_context(ca, cert, key)
+
+        net = TcpNet("127.0.0.1", 39481, ssl_server=server_ctx, ssl_client=client_ctx)
+        await net.start()
+        got = []
+
+        async def handler(sender, msg):
+            got.append((sender, msg))
+
+        net.register("127.0.0.1:39481/sup", handler)
+        net.send("replica-0", "127.0.0.1:39481/sup", M.ReadTag("K", 1))
+        await asyncio.sleep(0.3)
+        assert [type(m).__name__ for _, m in got] == ["ReadTag"]
+
+        # unauthenticated peer: trusts the CA but presents no client cert
+        certless = tlsutil.client_context(ca)
+        try:
+            _, w = await asyncio.open_connection(
+                "127.0.0.1", 39481, ssl=certless, server_hostname="localhost"
+            )
+            frame = b'{"src":"replica-1","dest":"127.0.0.1:39481/sup","msg":{}}'
+            w.write(len(frame).to_bytes(4, "big") + frame)
+            await w.drain()
+            await asyncio.sleep(0.3)
+            w.close()
+        except (_ssl.SSLError, ConnectionResetError):
+            pass  # handshake refusal is the expected outcome
+        assert len(got) == 1  # nothing further was delivered
+        await net.stop()
+
+    run(go())
+
+
+def test_launch_tcp_with_intranet_tls_end_to_end(tmp_path):
+    """launch() with transport=tcp + intranet mutual TLS: the full quorum
+    path (PutSet-style write then read) works over the TLS replica fabric."""
+
+    async def go():
+        from dds_tpu.run import launch
+        from dds_tpu.utils.config import DDSConfig
+
+        cfg = DDSConfig()
+        cfg.transport.kind = "tcp"
+        cfg.transport.port = 39491
+        cfg.security.intranet_tls_enabled = True
+        cfg.security.tls_dir = str(tmp_path)
+        cfg.proxy.port = 0
+        dep = await launch(cfg)
+        try:
+            assert dep.net._ssl_server is not None  # contexts actually wired
+            prefix = f"127.0.0.1:39491/"
+            abd = dep.server.abd
+            k, tag = await abd.write_set_tagged("tls-key", [41, 42])
+            assert k == "tls-key" and tag is not None
+            value, rtag = await abd.fetch_set_tagged("tls-key")
+            assert value == [41, 42] and rtag == tag
+            tags = await abd.read_tags(["tls-key"])
+            assert tags == [rtag]
+        finally:
+            await dep.stop()
+
+    run(go())
+
+
 def test_concurrent_suspects_single_recovery():
     async def go():
         c = Cluster()

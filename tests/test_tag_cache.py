@@ -1,11 +1,12 @@
 """Tag-read protocol + proxy aggregate-cache tests.
 
-The batched tag-only quorum read (`ITagRead`/`ReadTagBatch`) and the
-proxy's tag-validated aggregate cache replace the reference's per-aggregate
-full re-read of every stored set (`dds/http/DDSRestServer.scala:397-446`).
-These tests pin the safety argument: a cached value is served only when the
-quorum-max tag equals its cached tag, so external writes are always
-observed and Byzantine replicas can at worst force spurious re-fetches.
+The batched tag-only quorum read (`ReadTagBatch`, broadcast by the proxy
+itself) and the proxy's tag-validated aggregate cache replace the
+reference's per-aggregate full re-read of every stored set
+(`dds/http/DDSRestServer.scala:397-446`). These tests pin the safety
+argument: a cached value is served only when the quorum-max tag equals its
+cached tag, so external writes are always observed and Byzantine replicas
+can at worst force spurious re-fetches.
 """
 
 import asyncio
@@ -62,6 +63,42 @@ def test_write_reply_tag_matches_quorum():
     run(go())
 
 
+def test_read_tags_resists_tag_deflation_by_credentialed_minority():
+    """The attack the coordinator-mediated tag read was vulnerable to: a
+    Byzantine minority holding REAL MAC keys under-reports tags, trying to
+    make the proxy serve a superseded cached value. read_tags broadcasts
+    itself and maxes over a quorum of verified replies, and any quorum
+    intersects the completed write's quorum in an honest replica — so the
+    deflated vectors can never lower the result."""
+    from dds_tpu.utils import sigs as S
+
+    async def go():
+        c = Cluster()  # n=7, q=5, f=2
+        await c.client.write_set("k", [1])
+        await c.client.write_set("k", [2])  # tag seq >= 2 now
+        tags = await c.client.read_tags(["k"])
+        true_tag = tags[0]
+        assert true_tag.seq >= 2
+
+        secret = c.rcfg.abd_mac_secret
+
+        async def deflate(msg):
+            if isinstance(msg, M.TagBatchReply):
+                zero = (M.ABDTag(0, "forger"),) * len(msg.tags)
+                sig = S.abd_batch_signature(secret, zero, msg.digest, msg.nonce)
+                return M.TagBatchReply(zero, msg.digest, sig, msg.nonce)
+            return msg
+
+        # two credentialed liars deflate every tag reply on the wire
+        c.net.link_filters[("replica-5", "proxy-0")] = deflate
+        c.net.link_filters[("replica-6", "proxy-0")] = deflate
+        for _ in range(10):
+            got = await c.client.read_tags(["k"])
+            assert got[0] == true_tag  # never deflated below the true max
+
+    run(go())
+
+
 def test_read_tags_tolerates_byzantine_minority():
     async def go():
         c = Cluster()  # n=7, q=5, f=2
@@ -84,9 +121,7 @@ def test_read_tags_tolerates_byzantine_minority():
 
 def test_tag_messages_serialization_roundtrip():
     msgs = [
-        M.ITagRead(("a", "b")),
-        M.ITagReply("digest", (M.ABDTag(1, "r0"), M.ABDTag(2, "r1"))),
-        M.ReadTagBatch(("a",), 42),
+        M.ReadTagBatch(("a", "b"), 42, b"\x07"),
         M.TagBatchReply((M.ABDTag(3, "r2"),), "digest", b"\x01\x02", 42),
     ]
     for m in msgs:
@@ -100,6 +135,72 @@ def test_crafted_column_values_stay_opaque():
     row = [1, {"__msg__": "nope"}, {"__tag__": [5, "x"]}, {"__b64__": "AA=="}]
     env = M.Envelope(M.IWrite("k", row), 1, b"s")
     assert M.loads(M.dumps(env)) == env
+
+
+def test_unauthenticated_tag_batch_is_ignored():
+    """A ReadTagBatch without a valid proxy MAC gets no reply and burns no
+    anti-replay nonce (else unauthenticated traffic could enumerate tags
+    and grow the nonce set without bound)."""
+
+    async def go():
+        c = Cluster()
+        await c.client.write_set("k", [1])
+        target = c.replicas["replica-0"]
+        before = dict(target.incoming)
+        got = []
+        c.net.register("intruder", lambda s, m: (got.append(m), asyncio.sleep(0))[1])
+        c.net.send("intruder", "replica-0", M.ReadTagBatch(("k",), 999, b"bogus"))
+        await c.net.quiesce()
+        assert got == []
+        assert target.incoming == before
+
+    run(go())
+
+
+def test_read_tags_fails_fast_below_quorum():
+    async def go():
+        c = Cluster()
+        await c.client.write_set("k", [1])
+        for r in ("replica-0", "replica-1", "replica-2"):
+            for _ in range(3):
+                c.client.replicas.increment_suspicion(r)
+        try:
+            await c.client.read_tags(["k"])
+        except ByzantineError:
+            return
+        raise AssertionError("read_tags should fail fast below quorum")
+
+    run(go())
+
+
+def test_in_transit_tag_substitution_is_rejected():
+    """Reply tags are covered by the proxy HMAC: an attacker on the
+    replica->proxy channel who swaps in a guessed (predictable) tag must
+    trigger ByzInvalidSignatureError, not poison the tag-validated cache."""
+    from dataclasses import replace
+
+    async def go():
+        c = Cluster()
+        await c.client.write_set("k", [1])
+
+        async def swap_tag(msg):
+            if isinstance(msg, M.Envelope):
+                inner = msg.call
+                if isinstance(inner, (M.IReadReply, M.IWriteReply)) and inner.tag:
+                    forged = M.ABDTag(inner.tag.seq + 1, inner.tag.id)
+                    return replace(msg, call=replace(inner, tag=forged))
+            return msg
+
+        c.net.link_filters["proxy-0"] = swap_tag
+        for op in (lambda: c.client.fetch_set_tagged("k"),
+                   lambda: c.client.write_set_tagged("k", [2])):
+            try:
+                await op()
+            except ByzantineError:
+                continue
+            raise AssertionError("forged reply tag was accepted")
+
+    run(go())
 
 
 def test_defer_to_exclusion_picks_a_different_coordinator():
