@@ -63,6 +63,7 @@ class AbdClient:
         self.replicas = TrustedNodesList(replicas)
         # challenge nonce -> (future, coordinator)
         self._pending: dict[int, tuple[asyncio.Future, str]] = {}
+        self._preferred: list[str] = []  # supervisor's freshest-half view
         # tag-broadcast nonce -> (future, sender->tags votes, digest, keys)
         self._pending_tags: dict[int, tuple] = {}
         net.register(addr, self.handle)
@@ -81,7 +82,12 @@ class AbdClient:
                 log.warning("ignoring ActiveReplicas from non-supervisor %s", sender)
                 return
             if msg.replicas:
-                self.replicas.reset(msg.replicas)
+                # the supervisor serves only the freshest HALF of the active
+                # list (coordinator load-balancing, DDSRestServer.scala:139-147)
+                # — merge, don't reset: broadcasts (read_tags) need the whole
+                # quorum membership, which a partial view must not shrink
+                self.replicas.merge(msg.replicas)
+                self._preferred = list(msg.replicas)
             return
         # junk from a coordinator we are waiting on resolves that request
         # (Akka-ask semantics); validation will reject it.
@@ -92,7 +98,7 @@ class AbdClient:
         log.debug("unmatched message from %s: %s", sender, type(msg).__name__)
 
     async def _ask(self, call, nonce: int, signature: bytes, exclude=()):
-        coordinator = self.replicas.defer_to(exclude)
+        coordinator = self.replicas.defer_to(exclude, prefer=self._preferred)
         challenge = nonce + self.cfg.nonce_increment
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[challenge] = (fut, coordinator)

@@ -148,6 +148,8 @@ class TcpNet(Transport):
         self._server = await asyncio.start_server(
             self._serve, self.host, self.port, ssl=self._ssl_server
         )
+        if self.port == 0:  # resolve an OS-assigned port for local_addr()
+            self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
         # close outbound connections first: the EOF unblocks server-side

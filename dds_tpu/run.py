@@ -47,7 +47,8 @@ class Deployment:
     _stoppables: list = field(default_factory=list)
 
     async def stop(self) -> None:
-        await self.supervisor.stop()
+        if self.supervisor is not None:
+            await self.supervisor.stop()
         await self.server.stop()
         for s in self._stoppables:
             await s.stop()
@@ -98,9 +99,23 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             frame_secret=cfg.security.transport_frame_secret.encode() or None,
         )
         await net.start()
+        cfg.transport.port = net.port  # resolve OS-assigned port 0
         stoppables.append(net)
+        # Every endpoint must be a routable `host:port/name` address
+        # (`TcpNet.split`): names map through `replicas.addresses`, the
+        # per-host topology of `dds-system.conf:113-128`; unmapped names
+        # live in this process.
+        local_hostport = f"{net.host}:{net.port}"
+
+        def full(name: str) -> str:
+            return f"{cfg.replicas.addresses.get(name, local_hostport)}/{name}"
+
     else:
         net = InMemoryNet()
+        local_hostport = None
+
+        def full(name: str) -> str:
+            return name
 
     rcfg = ReplicaConfig(
         quorum_size=cfg.replicas.byz_quorum_size,
@@ -110,15 +125,43 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
         debug=cfg.debug,
     )
 
-    endpoints = list(cfg.replicas.endpoints)
-    sentinent = [e for e in endpoints if e in set(cfg.replicas.sentinent)]
-    active = [e for e in endpoints if e not in set(cfg.replicas.sentinent)]
+    endpoints = [full(e) for e in cfg.replicas.endpoints]
+    sentinent_names = set(cfg.replicas.sentinent)
+    sentinent = [full(e) for e in cfg.replicas.endpoints if e in sentinent_names]
+    active = [e for e in endpoints if e not in set(sentinent)]
+
+    # `Main.scala:90-99`: a process spawns only ITS replicas; the rest of
+    # the quorum is reached over the fabric. Default = every name mapped to
+    # this process (memory transport: all of them).
+    if cfg.replicas.local:
+        local_names = set(cfg.replicas.local)
+    elif local_hostport is not None:
+        local_names = {
+            n for n in cfg.replicas.endpoints
+            if cfg.replicas.addresses.get(n, local_hostport) == local_hostport
+        }
+    else:
+        local_names = set(cfg.replicas.endpoints)
+
+    sup_local = (
+        local_hostport is None
+        or not cfg.replicas.supervisor_address
+        or cfg.replicas.supervisor_address == local_hostport
+    )
+    sup_addr = (
+        SUPERVISOR_NAME
+        if local_hostport is None
+        else f"{cfg.replicas.supervisor_address or local_hostport}/{SUPERVISOR_NAME}"
+    )
 
     replicas = {
-        e: BFTABDNode(e, endpoints, SUPERVISOR_NAME, net, rcfg) for e in endpoints
+        full(e): BFTABDNode(full(e), endpoints, sup_addr, net, rcfg)
+        for e in cfg.replicas.endpoints
+        if e in local_names
     }
     for e in sentinent:
-        replicas[e].behavior = "sentinent"  # Main.scala:96-98
+        if e in replicas:
+            replicas[e].behavior = "sentinent"  # Main.scala:96-98
 
     # optional snapshot restore + periodic save (core/snapshot.py)
     if cfg.recovery.snapshot_dir:
@@ -130,28 +173,30 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
                      cfg.recovery.snapshot_dir)
 
     async def redeploy(endpoint: str) -> None:
-        replicas[endpoint] = BFTABDNode(endpoint, endpoints, SUPERVISOR_NAME, net, rcfg)
+        replicas[endpoint] = BFTABDNode(endpoint, endpoints, sup_addr, net, rcfg)
 
-    supervisor = BFTSupervisor(
-        SUPERVISOR_NAME,
-        active,
-        sentinent,
-        net,
-        SupervisorConfig(
-            quorum_size=cfg.replicas.byz_quorum_size,
-            proactive_recovery_warmup=cfg.recovery.warm_up,
-            proactive_recovery_interval=cfg.recovery.interval,
-            sentinent_awake_timeout=cfg.recovery.sentinent_awake_timeout,
-            crashed_recovery_timeout=cfg.recovery.crashed_recovery_timeout,
-            proactive_recovery_enabled=cfg.recovery.enabled,
-            debug=cfg.debug,
-        ),
-        redeploy=redeploy,
-    )
-    supervisor.start()
+    supervisor = None
+    if sup_local:
+        supervisor = BFTSupervisor(
+            sup_addr,
+            active,
+            sentinent,
+            net,
+            SupervisorConfig(
+                quorum_size=cfg.replicas.byz_quorum_size,
+                proactive_recovery_warmup=cfg.recovery.warm_up,
+                proactive_recovery_interval=cfg.recovery.interval,
+                sentinent_awake_timeout=cfg.recovery.sentinent_awake_timeout,
+                crashed_recovery_timeout=cfg.recovery.crashed_recovery_timeout,
+                proactive_recovery_enabled=cfg.recovery.enabled,
+                debug=cfg.debug,
+            ),
+            redeploy=redeploy,
+        )
+        supervisor.start()
 
     abd = AbdClient(
-        "proxy-0",
+        full("proxy-0"),
         net,
         active,
         AbdClientConfig(
@@ -174,7 +219,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             key_sync_warmup=cfg.proxy.key_sync_warm_up,
             key_sync_interval=cfg.proxy.key_sync_interval,
             peers=cfg.proxy.remote_peers,
-            supervisor=SUPERVISOR_NAME,
+            supervisor=sup_addr,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),

@@ -25,6 +25,18 @@ class ReplicaTopology:
     )
     byz_quorum_size: int = 5           # dds-system.conf:131
     byz_max_faults: int = 2            # dds-system.conf:132
+    # Multi-host topology (transport.kind = "tcp" only), mirroring the
+    # reference's per-host endpoint URIs + `replicas.local` split
+    # (`dds-system.conf:113-128`, `Main.scala:90-99`):
+    # - addresses: replica name -> "host:port" of the process hosting it;
+    #   unmapped names default to this process's transport address.
+    # - local: names THIS process instantiates (empty = every name whose
+    #   address resolves to this process).
+    # - supervisor_address: "host:port" of the process running the
+    #   supervisor (empty = this process).
+    addresses: dict = field(default_factory=dict)
+    local: list[str] = field(default_factory=list)
+    supervisor_address: str = ""
 
 
 @dataclass

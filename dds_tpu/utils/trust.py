@@ -37,12 +37,23 @@ class TrustedNodesList:
         """Replace the membership, keeping strikes of surviving nodes."""
         self._strikes = {n: self._strikes.get(n, 0) for n in nodes}
 
-    def defer_to(self, exclude=()) -> str:
+    def merge(self, nodes: list[str]) -> None:
+        """Add members without dropping existing ones (strikes kept). Used
+        when a partial view arrives — e.g. the supervisor's freshest-half
+        `ActiveReplicas` — that must not shrink quorum membership."""
+        for n in nodes:
+            self._strikes.setdefault(n, 0)
+
+    def defer_to(self, exclude=(), prefer=()) -> str:
         """Pick a random trusted node, avoiding `exclude` when any other
         trusted node remains (used to pick a genuinely different
-        coordinator for corroborating re-reads)."""
+        coordinator for corroborating re-reads). `prefer` narrows the
+        choice to those nodes when any of them qualify (the reference
+        proxy load-balances over the supervisor's freshest-half list,
+        `DDSRestServer.scala:139-147`)."""
         trusted = self.get_trusted()
         if not trusted:
             raise RuntimeError("no trusted nodes left")
-        preferred = [n for n in trusted if n not in exclude]
-        return self._rng.choice(preferred or trusted)
+        candidates = [n for n in trusted if n not in exclude]
+        preferred = [n for n in candidates if n in prefer]
+        return self._rng.choice(preferred or candidates or trusted)
