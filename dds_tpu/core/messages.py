@@ -125,14 +125,27 @@ class ReadTagBatch:
     keys: tuple
     nonce: int
     signature: bytes = b""
+    # sha256 fingerprint of the proxy's cached tag vector for `keys` (in
+    # request order). A replica whose own vector fingerprints identically
+    # answers with a tiny `unchanged` reply instead of re-serializing and
+    # MACing all K tags — the steady-state fast path that keeps aggregate
+    # freshness validation O(1) per side when nothing was written.
+    fingerprint: Optional[bytes] = None
 
 
 @dataclass(frozen=True)
 class TagBatchReply:
-    tags: tuple   # ABDTag per key in the request's order
+    tags: tuple   # ABDTag per key in the request's order (empty if unchanged)
     digest: str
     signature: bytes
     nonce: int
+    # unchanged=True: "my tag vector fingerprints to `fingerprint`, which
+    # equals the one you sent" — signature then covers (fingerprint, digest,
+    # nonce) via abd_batch_unchanged_signature. A full reply (unchanged=
+    # False) also carries the replica's fingerprint so the proxy can adopt
+    # it for its next request.
+    unchanged: bool = False
+    fingerprint: Optional[bytes] = None
 
 
 @dataclass(frozen=True)

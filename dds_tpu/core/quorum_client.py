@@ -34,6 +34,10 @@ from dds_tpu.utils.trust import TrustedNodesList
 
 log = logging.getLogger("dds.quorum_client")
 
+# vote marker: "this replica's whole tag vector equals the caller's
+# fingerprinted cached vector" (see read_tags)
+_UNCHANGED = object()
+
 
 @dataclass
 class AbdClientConfig:
@@ -186,24 +190,46 @@ class AbdClient:
                 raise ByzUnknownReplyError(coord)
 
     def _on_tag_batch_reply(self, sender: str, msg: M.TagBatchReply) -> None:
-        fut, votes, digest, keys = self._pending_tags[msg.nonce]
+        fut, votes, digest, keys, fp = self._pending_tags[msg.nonce]
         if fut.done() or sender in votes:
             return
-        if (
-            msg.digest != digest
-            or len(msg.tags) != len(keys)
-            or not sigs.validate_abd_batch_signature(
-                self.cfg.abd_mac_secret, msg.tags, msg.digest, msg.nonce,
-                msg.signature,
-            )
-        ):
-            self.replicas.increment_suspicion(sender)
-            return
-        votes[sender] = tuple(msg.tags)
+        if msg.unchanged:
+            # "my vector equals the fingerprint you sent": only meaningful
+            # when we sent one and it matches; MAC covers (fp, digest, nonce)
+            if (
+                fp is None
+                or msg.fingerprint != fp
+                or msg.digest != digest
+                or not sigs.validate_abd_batch_unchanged_signature(
+                    self.cfg.abd_mac_secret, fp, msg.digest, msg.nonce,
+                    msg.signature,
+                )
+            ):
+                self.replicas.increment_suspicion(sender)
+                return
+            votes[sender] = _UNCHANGED
+        else:
+            if (
+                msg.digest != digest
+                or len(msg.tags) != len(keys)
+                or not sigs.validate_abd_batch_signature(
+                    self.cfg.abd_mac_secret, msg.tags, msg.digest, msg.nonce,
+                    msg.signature,
+                )
+            ):
+                self.replicas.increment_suspicion(sender)
+                return
+            votes[sender] = tuple(msg.tags)
         if len(votes) >= self.cfg.quorum_size:
             fut.set_result(list(votes.values()))
 
-    async def read_tags(self, keys: list[str]) -> list[M.ABDTag]:
+    async def read_tags(
+        self,
+        keys: list[str],
+        digest: str | None = None,
+        fingerprint: bytes | None = None,
+        cached_tags: list | None = None,
+    ) -> list[M.ABDTag]:
         """Batched freshness probe: the quorum-max tag per key via ONE
         tag-only round broadcast by the proxy ITSELF — `ReadTagBatch` fans
         out to every trusted replica, each reply's intranet MAC is verified
@@ -218,25 +244,52 @@ class AbdClient:
         frame secret alone does not stop a credentialed replica from
         stuffing the vote with spoofed senders. Cheap because no set
         contents travel — the cache-validation primitive behind the
-        proxy's aggregate cache."""
+        proxy's aggregate cache.
+
+        Steady-state fast path: pass `fingerprint` (sha256 of `cached_tags`
+        via sigs.tags_fingerprint) and replicas whose vector matches answer
+        `unchanged` without shipping K tags; an unchanged vote stands for
+        `cached_tags` itself in the quorum max (fingerprint equality is
+        vector equality). Deflation-resistance is unchanged — a replica
+        hiding a newer completed write behind a false `unchanged` is
+        outvoted by the honest quorum-intersection replica, whose full
+        reply carries the higher tag. What an unchanged echo DOES hand a
+        credentialed liar is a way to confirm the caller's cached vector
+        without knowing it — relevant only when that vector already holds
+        a tag a Byzantine coordinator planted, a forgery the planter could
+        always confirm itself; the caller's audit (not this round) is what
+        bounds that class either way. `digest` may be passed in when the
+        caller already computed the keys digest (it is part of the request
+        MAC either way)."""
         trusted = self.replicas.get_trusted()
         if len(trusted) < self.cfg.quorum_size:
             raise ByzUnknownReplyError(
                 f"only {len(trusted)} trusted replicas < quorum {self.cfg.quorum_size}"
             )
+        if fingerprint is not None and cached_tags is None:
+            raise ValueError("fingerprint requires cached_tags")
         nonce = sigs.generate_nonce()
-        digest = sigs.key_from_set(list(keys))
+        if digest is None:
+            digest = sigs.key_from_set(list(keys))
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, digest, nonce)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._pending_tags[nonce] = (fut, {}, digest, tuple(keys))
+        self._pending_tags[nonce] = (fut, {}, digest, tuple(keys), fingerprint)
         try:
             with tracer.span("abd.read_tags", k=len(keys)):
+                req = M.ReadTagBatch(tuple(keys), nonce, sig, fingerprint)
                 for replica in trusted:
-                    self.net.send(
-                        self.addr, replica, M.ReadTagBatch(tuple(keys), nonce, sig)
-                    )
+                    self.net.send(self.addr, replica, req)
                 vectors = await asyncio.wait_for(fut, self.cfg.request_timeout)
-            return [max(col) for col in zip(*vectors)] if keys else []
+            if not keys:
+                return []
+            if all(v is _UNCHANGED for v in vectors):
+                # return the caller's own list BY IDENTITY: callers use
+                # `result is cached_tags` as the all-fresh signal
+                return cached_tags
+            expanded = [
+                cached_tags if v is _UNCHANGED else v for v in vectors
+            ]
+            return [max(col) for col in zip(*expanded)]
         finally:
             self._pending_tags.pop(nonce, None)
 

