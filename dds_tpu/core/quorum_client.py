@@ -68,7 +68,8 @@ class AbdClient:
         # challenge nonce -> (future, coordinator)
         self._pending: dict[int, tuple[asyncio.Future, str]] = {}
         self._preferred: list[str] = []  # supervisor's freshest-half view
-        # tag-broadcast nonce -> (future, sender->tags votes, digest, keys)
+        # tag-broadcast nonce -> (future, sender->tags votes, digest, keys,
+        # request fingerprint | None)
         self._pending_tags: dict[int, tuple] = {}
         net.register(addr, self.handle)
 

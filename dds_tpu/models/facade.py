@@ -27,6 +27,10 @@ DEFAULT_SCHEMA = ["OPE", "CHE", "PSSE", "MSE", "CHE", "CHE", "CHE", "None"]
 @dataclass(frozen=True)
 class HomoProvider:
     keys: HEKeys
+    # DJN short-exponent obfuscators for PSSE encryption (see
+    # PaillierPublicKey.blind_fast): ~5x cheaper per ciphertext on the
+    # client, standard variant. False = textbook full-width r^n.
+    fast_blinding: bool = True
 
     @staticmethod
     def generate(paillier_bits: int = 2048, rsa_bits: int = 1024) -> "HomoProvider":
@@ -42,6 +46,8 @@ class HomoProvider:
             case "CHE":
                 return k.che.encrypt(str(value))
             case "PSSE":
+                if self.fast_blinding:
+                    return str(k.psse.public.encrypt_fast(int(value)))
                 return str(k.psse.public.encrypt(int(value)))
             case "MSE":
                 return str(k.mse.public.encrypt(int(value)))

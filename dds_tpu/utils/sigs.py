@@ -64,12 +64,15 @@ def tag_payload(tag):
 
 def tags_blob(tags) -> bytes:
     """Packed byte form of a tag vector for MACs and fingerprints:
-    "seq:id" joined by ";". Both the replica (signer) and proxy (verifier)
-    derive this from their own ABDTag objects so wire-codec differences
-    can't skew the MAC input. Unambiguous because seq is an int and ids
-    contain no ":"/";" (node names); ~6x cheaper than canonical JSON at
-    K=8192, which matters — it sits on the per-aggregate hot path."""
-    return ";".join(f"{t.seq}:{t.id}" for t in tags).encode()
+    "seq:len(id):id" fields joined by ";". Both the replica (signer) and
+    proxy (verifier) derive this from their own ABDTag objects so
+    wire-codec differences can't skew the MAC input. The id is length-
+    prefixed because ids originate from wire messages and are never
+    charset-checked — without the prefix, delimiter characters inside an
+    id would make the packing non-injective and two distinct vectors
+    could share one MAC. ~6x cheaper than canonical JSON at K=8192,
+    which matters — it sits on the per-aggregate hot path."""
+    return ";".join(f"{t.seq}:{len(t.id)}:{t.id}" for t in tags).encode()
 
 
 def tags_fingerprint(tags) -> bytes:

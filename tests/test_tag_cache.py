@@ -131,6 +131,18 @@ def test_tag_messages_serialization_roundtrip():
         assert M.loads(M.dumps(m)) == m
 
 
+def test_tags_blob_packing_is_injective():
+    """Tag ids come off the wire uncharset-checked: the packed MAC input
+    must stay injective even when ids embed the delimiter characters
+    (regression: 'seq:id' joined by ';' let two distinct vectors collide)."""
+    from dds_tpu.utils import sigs as S
+
+    a = (M.ABDTag(1, "x;9:y"), M.ABDTag(2, "z"))
+    b = (M.ABDTag(1, "x"), M.ABDTag(9, "y;2:z"))
+    assert S.tags_blob(a) != S.tags_blob(b)
+    assert S.tags_fingerprint(a) != S.tags_fingerprint(b)
+
+
 def test_read_tags_fingerprint_fast_path_identity():
     """Steady state: when every quorum vote is `unchanged`, read_tags
     returns the caller's cached_tags list BY IDENTITY (the all-fresh
@@ -328,6 +340,47 @@ def test_in_transit_tag_substitution_is_rejected():
     run(go())
 
 
+def test_read_skips_writeback_when_quorum_agrees():
+    """Standard ABD read optimization: when every quorum member reports the
+    same (tag, value), the value is already at a full quorum and the read
+    answers without the write-back phase; a divergent member still triggers
+    the repairing write-back."""
+
+    async def go():
+        c = Cluster()
+        await c.client.write_set("k", [1])
+        await c.net.quiesce()
+        writes = []
+        orig_send = c.net.send
+
+        def counting_send(src, dest, msg):
+            if isinstance(msg, M.Write):
+                writes.append((src, dest))
+            orig_send(src, dest, msg)
+
+        c.net.send = counting_send
+        v, t = await c.client.fetch_set_tagged("k")
+        assert v == [1]
+        assert writes == []  # all replicas agreed: no write-back round
+
+        # a lagging replica (stale tag) forces the repair write-back
+        lagger = c.replicas["replica-3"]
+        lagger.repository["k"] = (M.ABDTag(0, lagger.name), None)
+        lagger.repo_version += 1
+        for _ in range(10):  # until the lagger lands in the read quorum
+            writes.clear()
+            v, t2 = await c.client.fetch_set_tagged("k")
+            assert v == [1] and t2 == t
+            if writes:
+                break
+        else:
+            raise AssertionError("divergent replica never triggered write-back")
+        await c.net.quiesce()
+        assert lagger.repository["k"][1] == [1]  # repaired
+
+    run(go())
+
+
 def test_defer_to_exclusion_picks_a_different_coordinator():
     """The audit's corroborating re-read must not land on the coordinator
     it is checking: defer_to(exclude) avoids it whenever another trusted
@@ -471,7 +524,7 @@ def test_audit_benign_concurrent_write_refreshes_without_flush():
             # the race where read_tags completes just before the write lands
             stale_tags = {k: server._cache[k][0] for k in keys}
 
-            async def frozen_read_tags(ks):
+            async def frozen_read_tags(ks, **_kw):
                 return [stale_tags[k] for k in ks]
 
             server.abd.read_tags = frozen_read_tags
