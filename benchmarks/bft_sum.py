@@ -85,12 +85,25 @@ async def _bench_backend(backend: str, enc_rows: list, total: int, requests: int
             status, _ = await http_request(host, port, "GET", target, timeout=300.0)
             assert status == 200
 
-        # ---- sequential latency ----------------------------------------
+        # ---- sequential latency (tracer-phased) ------------------------
+        from dds_tpu.utils.trace import tracer
+
+        tracer.reset()
         seq = []
         for _ in range(requests):
             t0 = time.perf_counter()
             await timed_get()
             seq.append(time.perf_counter() - t0)
+        # per-phase split of the sequential requests: validation round
+        # (abd.read_tags), audit quorum reads (abd.fetch), fold dispatch
+        # (proxy.fold), whole-aggregate bookkeeping (proxy.fetch_stored)
+        phases = {
+            name: s["mean_ms"]
+            for name, s in tracer.summary().items()
+            if name in ("abd.read_tags", "abd.fetch", "proxy.fold",
+                        "proxy.fetch_stored", "http.GET.SumAll")
+            and "mean_ms" in s
+        }
 
         # ---- concurrent serving throughput -----------------------------
         rounds = max(2, requests // 2)
@@ -108,6 +121,7 @@ async def _bench_backend(backend: str, enc_rows: list, total: int, requests: int
             "sumall_ms_concurrent": per_req * 1e3,
             "sumall_ms_cold": cold_s * 1e3,
             "putset_ops_per_sec": K / put_s,
+            "phase_mean_ms": phases,
         }
     finally:
         await dep.stop()
@@ -173,6 +187,8 @@ def main(argv=None):
             cpu_sumall_ms_seq=round(cpu["sumall_ms_seq"], 2),
             cpu_sumall_ms_concurrent=round(cpu["sumall_ms_concurrent"], 2),
             putset_ops_per_sec=round(tpu["putset_ops_per_sec"], 1),
+            tpu_phase_mean_ms=tpu["phase_mean_ms"],
+            cpu_phase_mean_ms=cpu["phase_mean_ms"],
         )
     ]
 

@@ -245,6 +245,12 @@ class DDSRestServer:
         """
         import random
 
+        with tracer.span("proxy.fetch_stored"):
+            return await self._fetch_stored_traced()
+
+    async def _fetch_stored_traced(self) -> list[tuple[str, list]]:
+        import random
+
         state, keys, cached, digest, fp, cached_tags = self._agg_state()
         if not keys:
             return []
@@ -623,7 +629,9 @@ class DDSRestServer:
             fold = getattr(
                 self.backend, "modmul_fold_resident", self.backend.modmul_fold
             )
-            result = await asyncio.to_thread(fold, operands, modulus)
+            with tracer.span("proxy.fold", k=len(operands),
+                             backend=self.backend.name):
+                result = await asyncio.to_thread(fold, operands, modulus)
         elif modparam == "nsqr":
             result = sum(operands)
         else:
