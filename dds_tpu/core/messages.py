@@ -200,6 +200,26 @@ class Kill:
 
 
 @dataclass(frozen=True)
+class Redeploy:
+    """Supervisor -> node-host agent: rebuild a fresh replica at `endpoint`
+    (the host owning it re-instantiates and re-registers the node). The
+    TCP analogue of the reference's remote actor deployment on a dead
+    host (`BFTSupervisor.scala:130-149`, RemoteScope). Authentication is
+    the transport's (frame MAC / mutual TLS / node signatures), the same
+    trust the in-protocol Kill/Sleep control messages ride."""
+
+    endpoint: str
+
+
+@dataclass(frozen=True)
+class Redeployed:
+    """Node-host agent -> supervisor: the Redeploy target is registered
+    (freshly rebuilt, or found already alive — idempotent success)."""
+
+    endpoint: str
+
+
+@dataclass(frozen=True)
 class RequestReplicas:
     pass
 
@@ -218,6 +238,15 @@ class Compromise:
     pass
 
 
+@dataclass(frozen=True)
+class Crash:
+    """Fault-injection control: the node tears its endpoint off the
+    transport and goes silent — the PoisonPill analogue that also works
+    across the TCP fabric (the reference's Trudy holds in-process
+    ActorRefs, `Trudy.scala:14-32`). A harness backdoor like Compromise,
+    not a production message."""
+
+
 # --------------------------------------------------------------------------
 # serialization: tagged canonical JSON
 # --------------------------------------------------------------------------
@@ -229,7 +258,8 @@ _TYPES = {
         ReadTag, TagReply, Write, WriteAck, Read, ReadReply,
         ReadTagBatch, TagBatchReply,
         Suspect, Awake, State, Sleep, Complying, Kill,
-        RequestReplicas, ActiveReplicas, Compromise,
+        Redeploy, Redeployed, RequestReplicas, ActiveReplicas, Compromise,
+        Crash,
     )
 }
 

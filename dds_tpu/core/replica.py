@@ -152,6 +152,10 @@ class BFTABDNode:
     # ------------------------------------------------------------- dispatch
 
     async def handle(self, sender: str, msg) -> None:
+        if isinstance(msg, M.Crash):
+            # fault-injection PoisonPill: go silent regardless of behavior
+            self.net.unregister(self.addr)
+            return
         if self.behavior == "healthy":
             await self._healthy(sender, msg)
         elif self.behavior == "sentinent":

@@ -43,6 +43,9 @@ class Transport:
     def send(self, src: str, dest: str, msg: object) -> None:
         raise NotImplementedError
 
+    def has_endpoint(self, addr: str) -> bool:
+        raise NotImplementedError
+
 
 class InMemoryNet(Transport):
     def __init__(self):
@@ -56,6 +59,9 @@ class InMemoryNet(Transport):
 
     def unregister(self, addr: str) -> None:
         self._handlers.pop(addr, None)
+
+    def has_endpoint(self, addr: str) -> bool:
+        return addr in self._handlers
 
     def send(self, src: str, dest: str, msg: object) -> None:
         task = asyncio.ensure_future(self._deliver(src, dest, msg))
@@ -109,6 +115,8 @@ class TcpNet(Transport):
         ssl_server=None,
         ssl_client=None,
         frame_secret: bytes | None = None,
+        node_key=None,
+        peer_keys: dict | None = None,
     ):
         self.host, self.port = host, port
         self._handlers: dict[str, Handler] = {}
@@ -116,14 +124,38 @@ class TcpNet(Transport):
         self._conns: dict[str, asyncio.StreamWriter] = {}
         self._ssl_server, self._ssl_client = ssl_server, ssl_client
         self._frame_secret = frame_secret
+        # per-node identity (utils/nodeauth): node_key is THIS process's
+        # Ed25519 private key; peer_keys maps "host:port" -> public key.
+        # When peer_keys is set, inbound frames are accepted only if their
+        # signature verifies against the claimed src's registered key —
+        # the sender-authenticity layer the sender-keyed quorum votes need
+        # (a shared frame secret or cluster-wide TLS cert only proves
+        # membership, not which member).
+        self._node_key = node_key
+        self._peer_keys = peer_keys
+        # signed frames carry a strictly increasing counter (seeded with
+        # wall time so process restarts keep increasing); receivers track
+        # the max seen per src host:port and drop non-increasing frames —
+        # without it a captured signed frame (e.g. a Kill) could be
+        # replayed verbatim. Sound because each sender->receiver pair
+        # rides ONE cached FIFO connection.
+        import itertools
+        import time as _time
+
+        self._send_ctr = itertools.count(_time.time_ns())
+        self._seen_ctr: dict[str, int] = {}
         self._lock = asyncio.Lock()
 
-    def _frame_mac(self, src: str, dest: str, payload: dict) -> str:
-        import hashlib
-        import hmac as hmac_mod
+    @staticmethod
+    def _frame_body(src: str, dest: str, payload: dict, ctr=None) -> bytes:
         import json
 
-        body = json.dumps([src, dest, payload], sort_keys=True).encode()
+        return json.dumps([src, dest, ctr, payload], sort_keys=True).encode()
+
+    def _frame_mac(self, body: bytes) -> str:
+        import hashlib
+        import hmac as hmac_mod
+
         return hmac_mod.new(self._frame_secret, body, hashlib.sha256).hexdigest()
 
     # endpoint addresses look like "host:port/name"
@@ -143,6 +175,10 @@ class TcpNet(Transport):
     def unregister(self, addr: str) -> None:
         _, _, name = self.split(addr) if "/" in addr else (None, None, addr)
         self._handlers.pop(name, None)
+
+    def has_endpoint(self, addr: str) -> bool:
+        name = addr.rsplit("/", 1)[-1]
+        return name in self._handlers
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -173,13 +209,33 @@ class TcpNet(Transport):
 
                 obj = json.loads(frame)
                 src, dest, payload = obj["src"], obj["dest"], obj["msg"]
+                body = None
+                if self._frame_secret is not None or self._peer_keys is not None:
+                    body = self._frame_body(src, dest, payload, obj.get("ctr"))
                 if self._frame_secret is not None:
                     import hmac as hmac_mod
 
                     if not hmac_mod.compare_digest(
-                        obj.get("mac", ""), self._frame_mac(src, dest, payload)
+                        obj.get("mac", ""), self._frame_mac(body)
                     ):
                         log.warning("dropping frame with bad MAC (src claims %s)", src)
+                        continue
+                if self._peer_keys is not None:
+                    src_host = src.split("/", 1)[0]
+                    pub = self._peer_keys.get(src_host)
+                    try:
+                        if pub is None:
+                            raise ValueError("unregistered src host")
+                        pub.verify(bytes.fromhex(obj.get("sig", "")), body)
+                        ctr = int(obj["ctr"])
+                        if ctr <= self._seen_ctr.get(src_host, -1):
+                            raise ValueError("replayed frame counter")
+                        self._seen_ctr[src_host] = ctr
+                    except Exception:
+                        log.warning(
+                            "dropping frame with bad/missing node signature "
+                            "or replayed counter (src claims %s)", src,
+                        )
                         continue
                 name = dest.split("/", 1)[1] if "/" in dest else dest
                 handler = self._handlers.get(name)
@@ -206,8 +262,15 @@ class TcpNet(Transport):
                     self._conns[conn_key] = w
             payload = M.to_dict(msg)
             obj = {"src": src, "dest": dest, "msg": payload}
-            if self._frame_secret is not None:
-                obj["mac"] = self._frame_mac(src, dest, payload)
+            if self._frame_secret is not None or self._node_key is not None:
+                ctr = next(self._send_ctr) if self._node_key is not None else None
+                if ctr is not None:
+                    obj["ctr"] = ctr
+                body = self._frame_body(src, dest, payload, ctr)
+                if self._frame_secret is not None:
+                    obj["mac"] = self._frame_mac(body)
+                if self._node_key is not None:
+                    obj["sig"] = self._node_key.sign(body).hex()
             frame = json.dumps(obj).encode()
             w.write(len(frame).to_bytes(4, "big") + frame)
             await w.drain()

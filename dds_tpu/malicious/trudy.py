@@ -35,22 +35,28 @@ def parse_attack(name: str) -> AttackType:
 
 class Trudy:
     def __init__(self, net: Transport, replicas: list[str], max_faults: int = 2,
-                 rng: random.Random | None = None):
+                 rng: random.Random | None = None, addr: str = "trudy"):
         self.net = net
         self.replicas = list(replicas)
         self.max_faults = max_faults
+        self.addr = addr  # routable src so attacks also ride a TCP fabric
         self._rng = rng or random.Random()
 
     def trigger(self, attack: AttackType | str) -> list[str]:
-        """Attack up to max_faults random replicas; returns the victims."""
+        """Attack up to max_faults random replicas; returns the victims.
+
+        Both attacks travel as transport messages (`Crash` / `Compromise`),
+        so they work identically on InMemoryNet and across a TcpNet
+        deployment — the reference's Trudy does the same through Akka
+        remoting ActorRefs (`Trudy.scala:14-32`)."""
         if isinstance(attack, str):
             attack = parse_attack(attack)
         victims = self._rng.sample(self.replicas, min(self.max_faults, len(self.replicas)))
         for v in victims:
             if attack is AttackType.CRASH:
                 log.info("Trudy crashes %s", v)
-                self.net.unregister(v)  # node goes silent (PoisonPill analogue)
+                self.net.send(self.addr, v, M.Crash())
             else:
                 log.info("Trudy compromises %s", v)
-                self.net.send("trudy", v, M.Compromise())
+                self.net.send(self.addr, v, M.Compromise())
         return victims

@@ -21,6 +21,8 @@ from dataclasses import dataclass, field
 from dds_tpu.clt.client import ClientConfig, DDSHttpClient
 from dds_tpu.clt.generator import generate
 from dds_tpu.clt.instructions import Digest
+from dds_tpu.core import messages as M
+from dds_tpu.utils.sigs import generate_nonce as sigs_generate_nonce
 from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
 from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
 from dds_tpu.core.supervisor import BFTSupervisor, SupervisorConfig
@@ -91,12 +93,24 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
 
     # transport fabric (SURVEY.md §5.8: control plane stays on CPU/asyncio)
     if cfg.transport.kind == "tcp":
+        node_key = peer_keys = None
+        if cfg.security.node_public_keys:
+            from dds_tpu.utils import nodeauth
+
+            if not cfg.security.node_key_path:
+                raise ValueError(
+                    "security.node_public_keys set but node_key_path empty"
+                )
+            node_key = nodeauth.load_or_create(cfg.security.node_key_path)
+            peer_keys = nodeauth.registry(cfg.security.node_public_keys)
         net = TcpNet(
             cfg.transport.host,
             cfg.transport.port,
             ssl_server=intranet_server,
             ssl_client=intranet_client,
             frame_secret=cfg.security.transport_frame_secret.encode() or None,
+            node_key=node_key,
+            peer_keys=peer_keys,
         )
         await net.start()
         cfg.transport.port = net.port  # resolve OS-assigned port 0
@@ -172,8 +186,63 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             log.info("restored %d replica snapshots from %s", restored,
                      cfg.recovery.snapshot_dir)
 
-    async def redeploy(endpoint: str) -> None:
+    def _rebuild_local(endpoint: str) -> None:
         replicas[endpoint] = BFTABDNode(endpoint, endpoints, sup_addr, net, rcfg)
+
+    # per-host node agent: honors the supervisor's Redeploy for replicas
+    # THIS process owns — the `Main` process is what re-instantiates
+    # actors in the reference's remote deployment too
+    # (`BFTSupervisor.scala:130-149`). A target still on the transport is
+    # NOT rebuilt (a stray/duplicate Redeploy must not wipe a live
+    # replica's state); either way the agent acks so the supervisor's
+    # reseed can proceed.
+    async def _nodehost(sender: str, msg) -> None:
+        if isinstance(msg, M.Redeploy) and msg.endpoint in replicas:
+            if net.has_endpoint(msg.endpoint):
+                log.info("nodehost: %s is alive, not rebuilding", msg.endpoint)
+            else:
+                log.info(
+                    "nodehost rebuilding %s (asked by %s)", msg.endpoint, sender
+                )
+                _rebuild_local(msg.endpoint)
+            net.send(full("nodehost"), sender, M.Redeployed(msg.endpoint))
+
+    net.register(full("nodehost"), _nodehost)
+
+    async def redeploy(endpoint: str) -> None:
+        """Supervisor redeploy hook: rebuild locally when this process owns
+        the endpoint, else ask the owning host's node agent over the
+        fabric and await its Redeployed ack (retrying a couple of times —
+        a silently dropped frame must not leave the supervisor reseeding
+        a node that was never rebuilt)."""
+        if endpoint in replicas:
+            if not net.has_endpoint(endpoint):
+                _rebuild_local(endpoint)
+            return
+        hostport = endpoint.rsplit("/", 1)[0]
+        ack: asyncio.Future = asyncio.get_event_loop().create_future()
+        tmp = full(f"redeploy-ack-{sigs_generate_nonce()}")
+
+        async def on_ack(sender: str, msg) -> None:
+            if (
+                isinstance(msg, M.Redeployed)
+                and msg.endpoint == endpoint
+                and not ack.done()
+            ):
+                ack.set_result(True)
+
+        net.register(tmp, on_ack)
+        try:
+            for _ in range(3):
+                net.send(tmp, f"{hostport}/nodehost", M.Redeploy(endpoint))
+                try:
+                    await asyncio.wait_for(asyncio.shield(ack), 1.0)
+                    return
+                except asyncio.TimeoutError:
+                    continue
+            log.warning("redeploy of %s was never acknowledged", endpoint)
+        finally:
+            net.unregister(tmp)
 
     supervisor = None
     if sup_local:
@@ -226,7 +295,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     )
     await server.start()
 
-    trudy = Trudy(net, active, cfg.replicas.byz_max_faults)
+    trudy = Trudy(net, active, cfg.replicas.byz_max_faults, addr=full("trudy"))
     dep = Deployment(cfg, net, replicas, supervisor, server, trudy, ssl_client,
                      stoppables)
 
@@ -256,13 +325,38 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     return dep
 
 
+def load_provider(cfg: DDSConfig) -> HomoProvider:
+    """Client HE keys per config: inline blob > keys file > fresh generation
+    (persisted back to the file when a path is configured) — the
+    `client.conf:81-88` reproducibility contract: a restarted client can
+    re-attach to an existing store and still decrypt it."""
+    import pathlib
+
+    from dds_tpu.models.keys import HEKeys
+
+    c = cfg.client
+    if c.he_keys_inline:
+        return HomoProvider(HEKeys.from_json(c.he_keys_inline))
+    if c.he_keys_path:
+        p = pathlib.Path(c.he_keys_path)
+        if p.exists():
+            return HomoProvider(HEKeys.from_json(p.read_text()))
+        keys = HEKeys.generate(c.paillier_bits, c.rsa_bits)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(keys.to_json())
+        try:
+            p.chmod(0o600)  # private keys decrypt the whole store
+        except OSError:
+            pass
+        return HomoProvider(keys)
+    return HomoProvider.generate(c.paillier_bits, c.rsa_bits)
+
+
 async def run_workload(dep: Deployment, provider: HomoProvider | None = None,
                        seed: int | None = None):
     """Spawn the configured clients and drive generated digests; returns reports."""
     cfg = dep.cfg
-    provider = provider or HomoProvider.generate(
-        cfg.client.paillier_bits, cfg.client.rsa_bits
-    )
+    provider = provider or load_provider(cfg)
     rng = random.Random(seed)
     dep.trudy._rng = rng  # make --seed reproduce attack victim selection
     dt = cfg.client.data_table

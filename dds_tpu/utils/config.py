@@ -60,6 +60,16 @@ class SecurityConfig:
     tls_cert: str = ""
     tls_key: str = ""
     tls_verify_hostname: bool = False  # reference's accept-all verifier default
+    # Per-node transport identity (utils/nodeauth, tcp transport only):
+    # binds every frame's claimed src to the sending PROCESS's Ed25519 key,
+    # so one compromised member cannot spoof another's sender-keyed quorum
+    # votes (WriteAck / Suspect / TagBatchReply). node_key_path holds this
+    # process's private key (hex; auto-generated if missing);
+    # node_public_keys maps every "host:port" to its public key hex,
+    # provisioned like the TLS certs. Enabled when node_public_keys is
+    # non-empty.
+    node_key_path: str = ""
+    node_public_keys: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -118,6 +128,15 @@ class ClientSettings:
     data_table: DataTableConfig = field(default_factory=DataTableConfig)
     paillier_bits: int = 2048
     rsa_bits: int = 1024
+    # HE key persistence (client.conf:81-88 ships serialized keys so runs
+    # are reproducible against existing data; same contract, sane format):
+    # - he_keys_path: load HEKeys JSON from this file if it exists; after
+    #   generating fresh keys, save them there so the next run (fresh
+    #   process) can decrypt yesterday's store.
+    # - he_keys_inline: a full HEKeys JSON blob directly in the config
+    #   (wins over the path when set).
+    he_keys_path: str = ""
+    he_keys_inline: str = ""
 
 
 @dataclass

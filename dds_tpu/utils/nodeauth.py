@@ -1,0 +1,69 @@
+"""Per-node transport credentials: Ed25519 frame signatures.
+
+The reference's intranet rides Akka netty-SSL remoting where every node
+presents the shared cluster keystore (`dds-system.conf:18-58`) — peers know
+a frame came from *a* cluster member, not from *which* one. Our quorum
+protocols key votes by sender (WriteAck / Suspect / TagBatchReply), so the
+fabric must bind the claimed `src` to a credential or one compromised
+member could stuff quorums with spoofed senders (core/quorum_client.py
+documents the hole this closes).
+
+Model: every PROCESS (transport endpoint, "host:port") holds an Ed25519
+keypair; a pre-provisioned registry maps each host:port to its public key
+(distributed exactly like the TLS certs). TcpNet signs every outbound
+frame over (src, dest, payload) and receivers verify the signature against
+the registry entry for the claimed src's host:port — a member B forging
+src addresses of member A fails verification because it cannot sign with
+A's key. Names WITHIN one process are not distinguished (one process, one
+trust domain).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+
+def generate() -> Ed25519PrivateKey:
+    return Ed25519PrivateKey.generate()
+
+
+def private_hex(key: Ed25519PrivateKey) -> str:
+    return key.private_bytes_raw().hex()
+
+
+def public_hex(key: Ed25519PrivateKey) -> str:
+    return key.public_key().public_bytes_raw().hex()
+
+
+def load_private(hexstr: str) -> Ed25519PrivateKey:
+    return Ed25519PrivateKey.from_private_bytes(bytes.fromhex(hexstr.strip()))
+
+
+def load_public(hexstr: str) -> Ed25519PublicKey:
+    return Ed25519PublicKey.from_public_bytes(bytes.fromhex(hexstr.strip()))
+
+
+def load_or_create(path: str | pathlib.Path) -> Ed25519PrivateKey:
+    """Process key from `path` (hex), generated on first use — the dev
+    flow; production provisions the file like it provisions TLS keys."""
+    p = pathlib.Path(path)
+    if p.exists():
+        return load_private(p.read_text())
+    key = generate()
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(private_hex(key))
+    try:
+        p.chmod(0o600)
+    except OSError:
+        pass
+    return key
+
+
+def registry(pubkeys: dict[str, str]) -> dict[str, Ed25519PublicKey]:
+    """Parse a {host:port -> public key hex} config map."""
+    return {hp: load_public(hx) for hp, hx in pubkeys.items()}

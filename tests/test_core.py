@@ -359,6 +359,85 @@ def test_tcp_intranet_mutual_tls_rejects_certless_peer(tmp_path):
     run(go())
 
 
+def test_node_signed_frames_reject_credentialed_src_forgery():
+    """Per-node frame signatures (utils/nodeauth): member B holds VALID
+    cluster credentials (its own Ed25519 key, registered in the registry)
+    but forges frames claiming member A's src addresses. The receiver
+    verifies the signature against the claimed src's registered key, so
+    B's forgeries are dropped while its honest frames flow — one
+    compromised member cannot stuff sender-keyed quorums (WriteAck /
+    Suspect / TagBatchReply) with spoofed votes."""
+
+    async def go():
+        import json as _json
+
+        from dds_tpu.core.transport import TcpNet
+        from dds_tpu.utils import nodeauth
+
+        key_a, key_b = nodeauth.generate(), nodeauth.generate()
+        reg = {
+            "127.0.0.1:39511": nodeauth.load_public(nodeauth.public_hex(key_a)),
+            "127.0.0.1:39512": nodeauth.load_public(nodeauth.public_hex(key_b)),
+        }
+        net_a = TcpNet("127.0.0.1", 39511, node_key=key_a, peer_keys=reg)
+        net_b = TcpNet("127.0.0.1", 39512, node_key=key_b, peer_keys=reg)
+        await net_a.start()
+        await net_b.start()
+        got = []
+
+        async def handler(sender, msg):
+            got.append((sender, type(msg).__name__))
+
+        net_a.register("127.0.0.1:39511/sup", handler)
+        try:
+            # honest frame from B: accepted
+            net_b.send("127.0.0.1:39512/replica-2", "127.0.0.1:39511/sup",
+                       M.WriteAck("k", 1))
+            # forgery: B signs with ITS key but claims A's own replica as src
+            net_b.send("127.0.0.1:39511/replica-0", "127.0.0.1:39511/sup",
+                       M.WriteAck("k", 2))
+            # forgery: B claims an unregistered host
+            net_b.send("10.0.0.9:999/replica-9", "127.0.0.1:39511/sup",
+                       M.WriteAck("k", 3))
+            await asyncio.sleep(0.3)
+            assert got == [("127.0.0.1:39512/replica-2", "WriteAck")]
+
+            # an unsigned frame (attacker without any node key) is dropped
+            r, w = await asyncio.open_connection("127.0.0.1", 39511)
+            frame = _json.dumps(
+                {"src": "127.0.0.1:39512/replica-2",
+                 "dest": "127.0.0.1:39511/sup",
+                 "msg": M.to_dict(M.WriteAck("k", 4))}
+            ).encode()
+            w.write(len(frame).to_bytes(4, "big") + frame)
+            await w.drain()
+            await asyncio.sleep(0.2)
+            w.close()
+            assert len(got) == 1
+
+            # a captured VALID signed frame replayed verbatim is dropped
+            # (the signed counter must strictly increase per src host)
+            src, dest = "127.0.0.1:39512/replica-2", "127.0.0.1:39511/sup"
+            payload = M.to_dict(M.WriteAck("k", 5))
+            ctr = 10**30  # far above anything sent so far
+            body = TcpNet._frame_body(src, dest, payload, ctr)
+            obj = {"src": src, "dest": dest, "msg": payload, "ctr": ctr,
+                   "sig": key_b.sign(body).hex()}
+            raw = _json.dumps(obj).encode()
+            r, w = await asyncio.open_connection("127.0.0.1", 39511)
+            for _ in range(2):  # original + replay
+                w.write(len(raw).to_bytes(4, "big") + raw)
+            await w.drain()
+            await asyncio.sleep(0.3)
+            w.close()
+            assert len(got) == 2  # exactly one of the two was accepted
+        finally:
+            await net_a.stop()
+            await net_b.stop()
+
+    run(go())
+
+
 def test_launch_tcp_with_intranet_tls_end_to_end(tmp_path):
     """launch() with transport=tcp + intranet mutual TLS: the full quorum
     path (PutSet-style write then read) works over the TLS replica fabric."""
@@ -388,6 +467,343 @@ def test_launch_tcp_with_intranet_tls_end_to_end(tmp_path):
             await dep.stop()
 
     run(go())
+
+
+def test_two_process_deployment_quorum_across_tcp(tmp_path):
+    """`Main.scala:90-99` + `dds-system.conf:113-128` parity: the same
+    binary runs on multiple hosts, each spawning only ITS replicas, with
+    the quorum spanning hosts over the intranet fabric. Two launch()es
+    (two TcpNets = two processes in miniature) host disjoint halves of a
+    4-replica f=1 quorum under mutual intranet TLS; writes and reads
+    coordinate across both, and BOTH proxies see the data."""
+
+    async def go():
+        from dds_tpu.run import launch
+        from dds_tpu.utils import tlsutil
+        from dds_tpu.utils.config import DDSConfig
+
+        from dds_tpu.utils import nodeauth
+
+        port_a, port_b = 39501, 39502
+        host_a, host_b = f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"
+        paths = tlsutil.generate_ca_and_cert(tmp_path, hosts=("127.0.0.1",))
+        # per-process Ed25519 identities, provisioned like the certs
+        key_a, key_b = nodeauth.generate(), nodeauth.generate()
+        (tmp_path / "node_a.key").write_text(nodeauth.private_hex(key_a))
+        (tmp_path / "node_b.key").write_text(nodeauth.private_hex(key_b))
+        registry = {host_a: nodeauth.public_hex(key_a),
+                    host_b: nodeauth.public_hex(key_b)}
+
+        def make_cfg(port, remote_map, local):
+            cfg = DDSConfig()
+            cfg.transport.kind = "tcp"
+            cfg.transport.port = port
+            cfg.replicas.endpoints = [f"replica-{i}" for i in range(4)]
+            cfg.replicas.sentinent = []
+            cfg.replicas.byz_quorum_size = 3
+            cfg.replicas.addresses = remote_map
+            cfg.replicas.local = local
+            cfg.replicas.supervisor_address = host_a  # supervisor on A
+            cfg.recovery.enabled = False
+            cfg.proxy.port = 0
+            cfg.security.intranet_tls_enabled = True
+            cfg.security.tls_ca = paths["ca"]
+            cfg.security.tls_cert = paths["cert"]
+            cfg.security.tls_key = paths["key"]
+            cfg.security.node_key_path = str(
+                tmp_path / ("node_a.key" if port == port_a else "node_b.key")
+            )
+            cfg.security.node_public_keys = dict(registry)
+            return cfg
+
+        cfg_a = make_cfg(
+            port_a, {"replica-2": host_b, "replica-3": host_b},
+            ["replica-0", "replica-1"],
+        )
+        cfg_b = make_cfg(
+            port_b, {"replica-0": host_a, "replica-1": host_a},
+            ["replica-2", "replica-3"],
+        )
+
+        dep_a = await launch(cfg_a)
+        dep_b = await launch(cfg_b)
+        try:
+            assert set(dep_a.replicas) == {f"{host_a}/replica-0",
+                                           f"{host_a}/replica-1"}
+            assert set(dep_b.replicas) == {f"{host_b}/replica-2",
+                                           f"{host_b}/replica-3"}
+            assert dep_a.supervisor is not None
+            assert dep_b.supervisor is None  # remote supervisor
+
+            # write through A's proxy: quorum 3 of 4 must span both hosts
+            k, tag = await dep_a.server.abd.write_set_tagged("xhost", [5, 6])
+            assert k == "xhost" and tag is not None
+            value, rtag = await dep_a.server.abd.fetch_set_tagged("xhost")
+            assert value == [5, 6] and rtag == tag
+            # B's proxy reads the same data through its own coordinators
+            value_b, rtag_b = await dep_b.server.abd.fetch_set_tagged("xhost")
+            assert value_b == [5, 6] and rtag_b == tag
+            # the batched tag round also spans hosts
+            tags = await dep_b.server.abd.read_tags(["xhost"])
+            assert tags == [tag]
+            # data actually lives on both hosts (quorum intersected)
+            holders = [
+                node for dep in (dep_a, dep_b)
+                for node in dep.replicas.values()
+                if node.repository.get("xhost", (None, None))[1] == [5, 6]
+            ]
+            assert len(holders) >= 3
+        finally:
+            await dep_b.stop()
+            await dep_a.stop()
+
+    run(go())
+
+
+def test_trudy_crash_and_suspicion_recovery_over_tcp():
+    """Fault injection + recovery on the REAL fabric (`Trudy.scala:14-32` +
+    `BFTSupervisor.scala:97-153`): Trudy's crash rides the TCP transport as
+    a Crash control message, the damaged quorum keeps serving, a suspicion
+    quorum then recovers the dead replica over TCP — spare promoted via
+    Awake/State, victim redeployed and reseeded via Sleep/Complying — and
+    the recovered fabric still completes quorums."""
+
+    async def go():
+        import random as _random
+
+        from dds_tpu.core.errors import ByzantineError
+        from dds_tpu.run import launch
+        from dds_tpu.utils.config import DDSConfig
+
+        port = 39531
+        prefix = f"127.0.0.1:{port}/"
+        cfg = DDSConfig()
+        cfg.transport.kind = "tcp"
+        cfg.transport.port = port
+        cfg.recovery.enabled = False  # manual recovery only, timing-clean
+        cfg.recovery.sentinent_awake_timeout = 1.0
+        cfg.recovery.crashed_recovery_timeout = 3.0
+        cfg.proxy.port = 0
+        cfg.proxy.intranet_request_timeout = 1.0
+        dep = await launch(cfg)
+        try:
+            abd = dep.server.abd
+            k, tag = await abd.write_set_tagged("rkey", [1, 2])
+            assert tag is not None
+
+            dep.trudy._rng = _random.Random(5)
+            victims = dep.trudy.trigger("crash")
+            assert len(victims) == 2
+            await asyncio.sleep(0.3)
+            # crashed endpoints are actually off the transport
+            for v in victims:
+                assert v.rsplit("/", 1)[-1] not in dep.net._handlers
+
+            # the damaged quorum (7-2=5 = q) still serves; a crashed
+            # coordinator draw times out and gets struck, so retry
+            for _ in range(8):
+                try:
+                    value, _ = await abd.fetch_set_tagged("rkey")
+                    break
+                except (ByzantineError, asyncio.TimeoutError):
+                    continue
+            else:
+                raise AssertionError("quorum never completed after crash")
+            assert value == [1, 2]
+
+            # suspicion quorum against one victim, voted over the fabric
+            victim = victims[0]
+            healthy = [a for a, _ in dep.supervisor.active if a not in victims]
+            for voter in healthy[:5]:
+                dep.net.send(
+                    voter, f"{prefix}supervisor",
+                    M.Suspect(victim, sigs.generate_nonce()),
+                )
+            # recovery: Awake spare (fast), Kill+Sleep victim (1s timeout,
+            # dead), redeploy, Sleep again -> Complying
+            for _ in range(40):
+                await asyncio.sleep(0.2)
+                if victim in dep.supervisor.sentinent:
+                    break
+            assert victim in dep.supervisor.sentinent
+            active_now = [a for a, _ in dep.supervisor.active]
+            assert victim not in active_now
+            assert len(active_now) == 7  # a spare was promoted
+            # the redeployed victim is back on the transport, reseeded
+            assert victim.rsplit("/", 1)[-1] in dep.net._handlers
+            assert dep.replicas[victim].repository.get("rkey", (None, None))[1] \
+                == [1, 2]
+
+            # recovered fabric completes fresh quorums (incl. the spare)
+            for _ in range(8):
+                try:
+                    k2, t2 = await abd.write_set_tagged("rkey2", [9])
+                    break
+                except (ByzantineError, asyncio.TimeoutError):
+                    continue
+            else:
+                raise AssertionError("quorum never completed after recovery")
+            assert t2 is not None
+        finally:
+            await dep.stop()
+
+    run(go())
+
+
+def test_cross_host_redeploy_recovers_dead_remote_replica():
+    """The RemoteScope parity case (`BFTSupervisor.scala:130-149`): the
+    supervisor on host A recovers a crashed replica living on host B — the
+    spare wakes over TCP, the victim's rebuild goes through B's node-host
+    agent, and the Sleep reseed lands on the fresh node."""
+
+    async def go():
+        from dds_tpu.core.errors import ByzantineError
+        from dds_tpu.run import launch
+        from dds_tpu.utils.config import DDSConfig
+
+        port_a, port_b = 39541, 39542
+        host_a, host_b = f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"
+
+        def make_cfg(port, remote_map, local):
+            cfg = DDSConfig()
+            cfg.transport.kind = "tcp"
+            cfg.transport.port = port
+            cfg.replicas.endpoints = [f"replica-{i}" for i in range(5)]
+            cfg.replicas.sentinent = ["replica-4"]
+            cfg.replicas.byz_quorum_size = 3   # n_active=4, f=1
+            cfg.replicas.addresses = remote_map
+            cfg.replicas.local = local
+            cfg.replicas.supervisor_address = host_a
+            cfg.recovery.enabled = False
+            cfg.recovery.sentinent_awake_timeout = 1.0
+            cfg.recovery.crashed_recovery_timeout = 3.0
+            cfg.proxy.port = 0
+            cfg.proxy.intranet_request_timeout = 1.0
+            return cfg
+
+        b_names = ("replica-3", "replica-4")
+        cfg_a = make_cfg(port_a, {n: host_b for n in b_names},
+                         ["replica-0", "replica-1", "replica-2"])
+        cfg_b = make_cfg(port_b,
+                         {n: host_a for n in ("replica-0", "replica-1",
+                                              "replica-2")},
+                         list(b_names))
+        dep_a = await launch(cfg_a)
+        dep_b = await launch(cfg_b)
+        try:
+            abd = dep_a.server.abd
+            await abd.write_set_tagged("xk", [3])
+
+            victim = f"{host_b}/replica-3"  # lives on B; supervisor on A
+            old_node = dep_b.replicas[victim]
+            dep_a.net.send(f"{host_a}/trudy", victim, M.Crash())
+            await asyncio.sleep(0.3)
+            assert "replica-3" not in dep_b.net._handlers  # actually dead
+
+            for voter in (f"{host_a}/replica-0", f"{host_a}/replica-1",
+                          f"{host_a}/replica-2"):
+                dep_a.net.send(voter, f"{host_a}/supervisor",
+                               M.Suspect(victim, sigs.generate_nonce()))
+            for _ in range(40):
+                await asyncio.sleep(0.2)
+                if victim in dep_a.supervisor.sentinent:
+                    break
+            assert victim in dep_a.supervisor.sentinent
+            # B's node agent rebuilt it: new object, re-registered, reseeded
+            new_node = dep_b.replicas[victim]
+            assert new_node is not old_node
+            assert "replica-3" in dep_b.net._handlers
+            assert new_node.repository.get("xk", (None, None))[1] == [3]
+            assert new_node.behavior == "sentinent"  # demoted after reseed
+
+            # the promoted spare keeps the quorum serving
+            for _ in range(8):
+                try:
+                    value, _ = await abd.fetch_set_tagged("xk")
+                    break
+                except (ByzantineError, asyncio.TimeoutError):
+                    continue
+            else:
+                raise AssertionError("quorum never completed after recovery")
+            assert value == [3]
+        finally:
+            await dep_b.stop()
+            await dep_a.stop()
+
+    run(go())
+
+
+def test_he_key_persistence_roundtrip(tmp_path):
+    """client.conf:81-88 contract: run 1 generates keys (persisted via
+    client.he_keys_path) and uploads encrypted rows; run 2's freshly-loaded
+    provider (a new process would do exactly this) decrypts SumAll against
+    the existing store. A provider with independent keys cannot."""
+
+    async def go():
+        import json as _json
+
+        from dds_tpu.http.miniserver import http_request
+        from dds_tpu.models.facade import HomoProvider
+        from dds_tpu.run import launch, load_provider
+        from dds_tpu.utils.config import DDSConfig
+
+        cfg = DDSConfig()
+        cfg.proxy.port = 0
+        cfg.recovery.enabled = False
+        cfg.client.paillier_bits = 1024  # keep keygen fast in tests
+        cfg.client.he_keys_path = str(tmp_path / "he_keys.json")
+
+        dep = await launch(cfg)
+        try:
+            host, port = cfg.proxy.host, dep.server.cfg.port
+            run1 = load_provider(cfg)  # generates + persists
+            vals = [7, 11]
+            for v in vals:
+                row = run1.encrypt_row([v], 1, ["PSSE"])
+                status, _ = await http_request(
+                    host, port, "POST", "/PutSet",
+                    _json.dumps({"contents": row}).encode(),
+                )
+                assert status == 200
+
+            run2 = load_provider(cfg)  # fresh object, loaded from disk
+            assert run2 is not run1
+            nsqr = run2.keys.psse.public.nsquare
+            status, body = await http_request(
+                host, port, "GET", f"/SumAll?position=0&nsqr={nsqr}"
+            )
+            assert status == 200
+            total = int(_json.loads(body)["result"])
+            assert run2.keys.psse.decrypt_signed(total) == sum(vals)
+
+            stranger = HomoProvider.generate(1024, 1024)
+            assert stranger.keys.psse.decrypt_signed(total) != sum(vals)
+        finally:
+            await dep.stop()
+
+    run(go())
+
+
+def test_he_keys_inline_config_wins_over_path(tmp_path):
+    """An inline HEKeys blob in the config takes precedence over the keys
+    file — the direct analogue of keys shipped inside client.conf."""
+    from dds_tpu.models.keys import HEKeys
+    from dds_tpu.run import load_provider
+    from dds_tpu.utils.config import DDSConfig
+
+    inline = HEKeys.generate(paillier_bits=1024, rsa_bits=1024)
+    other = HEKeys.generate(paillier_bits=1024, rsa_bits=1024)
+    path = tmp_path / "keys.json"
+    path.write_text(other.to_json())
+
+    cfg = DDSConfig()
+    cfg.client.he_keys_inline = inline.to_json()
+    cfg.client.he_keys_path = str(path)
+    p = load_provider(cfg)
+    assert p.keys.psse.n == inline.psse.n  # inline won
+    cfg.client.he_keys_inline = ""
+    p2 = load_provider(cfg)
+    assert p2.keys.psse.n == other.psse.n  # falls back to the file
 
 
 def test_concurrent_suspects_single_recovery():
