@@ -1,7 +1,10 @@
-"""North-star benchmark: encrypted SUM throughput @ Paillier-2048.
-
-Measures the proxy-side homomorphic-add fold (the compute inside the
-`SumAll` route, = the reference's per-ciphertext `HomoAdd.sum` loop at
+"""North-star benchmark: encrypted SUM throughput @ Paillier-2048 under
+the 4-replica (f=1) BFT quorum, END TO END (BASELINE.json's metric as
+written): client-encrypted rows loaded through real quorum writes, then
+timed `SumAll` requests through the REST proxy — per-request quorum
+tag-validation + audit + the full homomorphic fold, decrypt-verified.
+`--worker --kernel` measures the kernel-only fold (the compute inside
+`SumAll`, = the reference's `HomoAdd.sum` loop at
 `dds/http/DDSRestServer.scala:412-430`) on both crypto backends:
 
 - cpu:  sequential python-int modmul fold mod n^2 over ciphertexts in host
@@ -36,14 +39,52 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
-METRIC = "encrypted SUM ops/sec @ Paillier-2048 (batched homomorphic add)"
+sys.path.insert(0, REPO)  # runnable as `python /path/to/bench.py` too
+from benchmarks.bft_sum import METRIC  # noqa: E402 — lightweight import
 
 
 # --------------------------------------------------------------------------
 # worker: the real measurement (runs in a subprocess spawned by the driver)
 # --------------------------------------------------------------------------
 
-def bench(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
+def bench(K: int = 32768, requests: int = 4, concurrency: int = 8) -> dict:
+    """The north-star number AS WRITTEN in BASELINE.json: encrypted SUM
+    throughput *under the 4-replica (f=1) BFT quorum*, end to end — K
+    client-encrypted rows loaded through real HMAC'd quorum writes, then
+    `SumAll` requests through the REST proxy (per-request tag-validation
+    quorum round + audit + full homomorphic fold; decrypt-verified).
+    Earlier rounds headlined the kernel-only fold here (86-102x) while the
+    end-to-end figure sat at ~1x; the protocol overhead is now O(1) per
+    request so the honest end-to-end number is the headline. Kernel-only
+    figures remain in benchmarks/results.json + BASELINE.md."""
+    from benchmarks.bft_sum import run_both
+
+    cpu, tpu = run_both(K, requests, concurrency)
+    ratio = tpu["adds_per_sec"] / cpu["adds_per_sec"]
+    return {
+        "metric": METRIC,
+        "value": round(tpu["adds_per_sec"], 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ratio, 3),
+        "detail": {
+            "K": K,
+            "quorum": 3,
+            "requests": requests,
+            "concurrency": concurrency,
+            "sustained": True,
+            "end_to_end": True,
+            "decrypt_verified": True,
+            "cpu_adds_per_sec": round(cpu["adds_per_sec"], 1),
+            "tpu_sumall_ms_seq": round(tpu["sumall_ms_seq"], 2),
+            "tpu_sumall_ms_concurrent": round(tpu["sumall_ms_concurrent"], 2),
+            "cpu_sumall_ms_seq": round(cpu["sumall_ms_seq"], 2),
+            "tpu_phase_mean_ms": tpu["phase_mean_ms"],
+            "putset_ops_per_sec": round(tpu["putset_ops_per_sec"], 1),
+        },
+    }
+
+
+def bench_kernel(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
     import jax
     import numpy as np
 
@@ -114,7 +155,7 @@ def bench(K: int = 65536, repeats: int = 3, verify: bool = True) -> dict:
         lat_ms.append((time.perf_counter() - t0) * 1e3)
 
     return {
-        "metric": METRIC,
+        "metric": "encrypted SUM ops/sec @ Paillier-2048 (batched homomorphic add, kernel only)",
         "value": round(tpu_ops, 1),
         "unit": "ops/s",
         "vs_baseline": round(tpu_ops / cpu_ops, 3),
@@ -231,7 +272,7 @@ def _driver() -> dict:
     probe_deadline = float(os.environ.get("DDS_BENCH_PROBE_DEADLINE", "420"))
     probe_timeout = float(os.environ.get("DDS_BENCH_PROBE_TIMEOUT", "75"))
     probe_sleep = float(os.environ.get("DDS_BENCH_PROBE_SLEEP", "45"))
-    worker_timeout = float(os.environ.get("DDS_BENCH_WORKER_TIMEOUT", "700"))
+    worker_timeout = float(os.environ.get("DDS_BENCH_WORKER_TIMEOUT", "1000"))
     attempts = int(os.environ.get("DDS_BENCH_ATTEMPTS", "2"))
 
     errors: list[str] = []
@@ -277,7 +318,8 @@ def _driver() -> dict:
 
 def main() -> int:
     if "--worker" in sys.argv[1:]:
-        print(json.dumps(bench()), flush=True)
+        fn = bench_kernel if "--kernel" in sys.argv[1:] else bench
+        print(json.dumps(fn()), flush=True)
         return 0
     try:
         row = _driver()

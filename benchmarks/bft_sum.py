@@ -36,6 +36,30 @@ from benchmarks.common import emit
 
 PSSE_POS = 2  # canonical schema column 2 is PSSE (client.conf:50-61)
 
+# the BASELINE.json north-star metric, shared with bench.py's headline
+METRIC = "end-to-end encrypted SUM adds/sec @ Paillier-2048, 4-replica BFT f=1"
+
+
+def run_both(k: int, requests: int, concurrency: int, cache: bool = True):
+    """Measure both backends on one generated row set; returns (cpu, tpu)
+    result dicts. The single orchestration shared by this module's CLI and
+    bench.py's worker."""
+    from dds_tpu.bench_key import bench_paillier_key
+
+    key = bench_paillier_key()
+    enc_rows, total = make_rows(k, key)
+
+    async def go():
+        cpu = await _bench_backend(
+            "cpu", enc_rows, total, requests, concurrency, cache, key
+        )
+        tpu = await _bench_backend(
+            "tpu", enc_rows, total, requests, concurrency, cache, key
+        )
+        return cpu, tpu
+
+    return asyncio.run(go())
+
 
 async def _bench_backend(backend: str, enc_rows: list, total: int, requests: int,
                          concurrency: int, cache: bool, key) -> dict:
@@ -153,25 +177,11 @@ def main(argv=None):
                     help="reference behavior: full ABD re-read per aggregate")
     args = ap.parse_args(argv)
 
-    from dds_tpu.bench_key import bench_paillier_key
-
-    key = bench_paillier_key()
-    enc_rows, total = make_rows(args.k, key)
     cache = not args.no_cache
-
-    async def go():
-        cpu = await _bench_backend(
-            "cpu", enc_rows, total, args.requests, args.concurrency, cache, key
-        )
-        tpu = await _bench_backend(
-            "tpu", enc_rows, total, args.requests, args.concurrency, cache, key
-        )
-        return cpu, tpu
-
-    cpu, tpu = asyncio.run(go())
+    cpu, tpu = run_both(args.k, args.requests, args.concurrency, cache)
     return [
         emit(
-            "end-to-end encrypted SUM adds/sec @ Paillier-2048, 4-replica BFT f=1",
+            METRIC,
             tpu["adds_per_sec"],
             "ops/s",
             tpu["adds_per_sec"] / cpu["adds_per_sec"],

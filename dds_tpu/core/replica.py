@@ -42,6 +42,12 @@ class ReplicaConfig:
     abd_mac_secret: bytes = b"intranet-abd-secret"
     proxy_mac_secret: bytes = b"rest2abd"
     debug: bool = False
+    # honor the Crash/Compromise fault-injection backdoors. True is the
+    # harness default (tests drive faults directly); deployments built by
+    # run.launch() set it from `attacks.enabled`, so a production config
+    # without attack simulation ignores injected faults entirely — one
+    # credentialed peer must not be able to kill replicas past f.
+    allow_fault_injection: bool = True
 
 
 @dataclass
@@ -152,10 +158,15 @@ class BFTABDNode:
     # ------------------------------------------------------------- dispatch
 
     async def handle(self, sender: str, msg) -> None:
-        if isinstance(msg, M.Crash):
-            # fault-injection PoisonPill: go silent regardless of behavior
-            self.net.unregister(self.addr)
-            return
+        if isinstance(msg, (M.Crash, M.Compromise)):
+            # fault-injection backdoors (Trudy): honored only when the
+            # deployment enables attack simulation
+            if not self.cfg.allow_fault_injection:
+                self._debug(f"ignoring injected {type(msg).__name__}")
+                return
+            if isinstance(msg, M.Crash):
+                self.net.unregister(self.addr)  # go silent, any behavior
+                return
         if self.behavior == "healthy":
             await self._healthy(sender, msg)
         elif self.behavior == "sentinent":

@@ -138,7 +138,14 @@ class TcpNet(Transport):
         # the max seen per src host:port and drop non-increasing frames —
         # without it a captured signed frame (e.g. a Kill) could be
         # replayed verbatim. Sound because each sender->receiver pair
-        # rides ONE cached FIFO connection.
+        # rides ONE cached FIFO connection. Known limits (documented, not
+        # closed): the receiver-side counter state is in-memory, so frames
+        # captured before a receiver RESTART can be replayed into the
+        # fresh process until the genuine sender next transmits; and a
+        # sender whose clock steps far backwards across ITS restart sends
+        # below peers' recorded max until the clock catches up. Pair with
+        # intranet TLS (which closes on-path capture entirely) where those
+        # windows matter.
         import itertools
         import time as _time
 
@@ -226,6 +233,14 @@ class TcpNet(Transport):
                     try:
                         if pub is None:
                             raise ValueError("unregistered src host")
+                        # the signed dest must name THIS process: endpoint
+                        # names repeat across hosts (proxy-0, nodehost), so
+                        # a frame captured on the wire to host A must not
+                        # verify and dispatch on host B
+                        if "/" in dest and dest.split("/", 1)[0] != (
+                            f"{self.host}:{self.port}"
+                        ):
+                            raise ValueError("frame destined for another host")
                         pub.verify(bytes.fromhex(obj.get("sig", "")), body)
                         ctr = int(obj["ctr"])
                         if ctr <= self._seen_ctr.get(src_host, -1):
@@ -233,8 +248,9 @@ class TcpNet(Transport):
                         self._seen_ctr[src_host] = ctr
                     except Exception:
                         log.warning(
-                            "dropping frame with bad/missing node signature "
-                            "or replayed counter (src claims %s)", src,
+                            "dropping frame with bad/missing node signature, "
+                            "wrong dest host, or replayed counter "
+                            "(src claims %s)", src,
                         )
                         continue
                 name = dest.split("/", 1)[1] if "/" in dest else dest

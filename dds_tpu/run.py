@@ -137,6 +137,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
         abd_mac_secret=cfg.security.abd_mac_secret.encode(),
         proxy_mac_secret=cfg.security.proxy_mac_secret.encode(),
         debug=cfg.debug,
+        allow_fault_injection=cfg.attacks.enabled,
     )
 
     endpoints = [full(e) for e in cfg.replicas.endpoints]
@@ -342,12 +343,10 @@ def load_provider(cfg: DDSConfig) -> HomoProvider:
         if p.exists():
             return HomoProvider(HEKeys.from_json(p.read_text()))
         keys = HEKeys.generate(c.paillier_bits, c.rsa_bits)
-        p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_text(keys.to_json())
-        try:
-            p.chmod(0o600)  # private keys decrypt the whole store
-        except OSError:
-            pass
+        from dds_tpu.utils.nodeauth import write_secret_file
+
+        # born 0600: these private keys decrypt the whole store
+        write_secret_file(p, keys.to_json())
         return HomoProvider(keys)
     return HomoProvider.generate(c.paillier_bits, c.rsa_bits)
 

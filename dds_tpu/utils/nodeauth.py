@@ -48,6 +48,18 @@ def load_public(hexstr: str) -> Ed25519PublicKey:
     return Ed25519PublicKey.from_public_bytes(bytes.fromhex(hexstr.strip()))
 
 
+def write_secret_file(path: str | pathlib.Path, content: str) -> None:
+    """Create a secret file born 0600 (O_EXCL) — never world-readable,
+    not even for the instant before a chmod."""
+    import os
+
+    p = pathlib.Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(content)
+
+
 def load_or_create(path: str | pathlib.Path) -> Ed25519PrivateKey:
     """Process key from `path` (hex), generated on first use — the dev
     flow; production provisions the file like it provisions TLS keys."""
@@ -55,12 +67,7 @@ def load_or_create(path: str | pathlib.Path) -> Ed25519PrivateKey:
     if p.exists():
         return load_private(p.read_text())
     key = generate()
-    p.parent.mkdir(parents=True, exist_ok=True)
-    p.write_text(private_hex(key))
-    try:
-        p.chmod(0o600)
-    except OSError:
-        pass
+    write_secret_file(p, private_hex(key))
     return key
 
 

@@ -580,6 +580,7 @@ def test_trudy_crash_and_suspicion_recovery_over_tcp():
         cfg = DDSConfig()
         cfg.transport.kind = "tcp"
         cfg.transport.port = port
+        cfg.attacks.enabled = True    # deployment honors Trudy's injections
         cfg.recovery.enabled = False  # manual recovery only, timing-clean
         cfg.recovery.sentinent_awake_timeout = 1.0
         cfg.recovery.crashed_recovery_timeout = 3.0
@@ -674,6 +675,7 @@ def test_cross_host_redeploy_recovers_dead_remote_replica():
             cfg.replicas.addresses = remote_map
             cfg.replicas.local = local
             cfg.replicas.supervisor_address = host_a
+            cfg.attacks.enabled = True
             cfg.recovery.enabled = False
             cfg.recovery.sentinent_awake_timeout = 1.0
             cfg.recovery.crashed_recovery_timeout = 3.0
