@@ -11,7 +11,12 @@ Recovery (BFTSupervisor.scala:97-153): wake a random sentinent spare
 offender (guardian-restart semantics) and re-seed it with the spare's state
 via `Sleep` -> `Complying`, demoting it to sentinent. If the offender's
 host is dead (ask timeout), redeploy a fresh replica at the same endpoint
-through the injected factory and seed that instead.
+through the injected factory and seed that instead. Nodes that prove
+unreachable past their timeouts — a spare that never Awakes, or an
+offender that never Complies after redeploy — are DROPPED from membership
+with a loud warning rather than kept as phantoms (deviation from the
+reference, which would retry them forever); the operator restores them
+explicitly.
 
 Deviations (documented): suspicion voters are the *senders* of Suspect
 votes (the reference seeds the voter set with the suspected node itself,
@@ -151,19 +156,33 @@ class BFTSupervisor:
         if byzantine not in (a for a, _ in self.active):
             log.warning("refusing to recover non-active endpoint %s", byzantine)
             return
-        spares = [s for s in self.sentinent if s not in self._recovering]
-        if not spares:
-            return
-        spare = self._rng.choice(spares)
-        self._recovering.update((byzantine, spare))
+        self._recovering.add(byzantine)
+        spare = None
         try:
-            try:
-                state = await self._ask(
-                    spare, M.Awake(), "State", self.cfg.sentinent_awake_timeout
-                )
-            except asyncio.TimeoutError:
-                log.warning("sentinent %s did not wake up", spare)
-                return
+            while True:
+                spares = [s for s in self.sentinent if s not in self._recovering]
+                if not spares:
+                    return
+                spare = self._rng.choice(spares)
+                self._recovering.add(spare)
+                try:
+                    state = await self._ask(
+                        spare, M.Awake(), "State",
+                        self.cfg.sentinent_awake_timeout,
+                    )
+                    break
+                except asyncio.TimeoutError:
+                    # a spare that cannot Awake is GONE, not a spare: keep
+                    # it listed and every future recovery re-picks the same
+                    # phantom while the real offender stays active. Drop
+                    # it and try the next spare.
+                    log.warning(
+                        "sentinent %s did not wake up; dropping it from "
+                        "membership (operator action required)", spare,
+                    )
+                    self.sentinent.remove(spare)
+                    self._recovering.discard(spare)
+                    spare = None
 
             # promote the spare
             self.sentinent.remove(spare)
@@ -198,8 +217,21 @@ class BFTSupervisor:
                         self.cfg.crashed_recovery_timeout,
                     )
                 except asyncio.TimeoutError:
-                    log.warning("rebooted replica %s never complied", byzantine)
+                    # A node that never complied after a redeploy is GONE,
+                    # not a spare: listing it as sentinent would make later
+                    # recoveries pick a phantom (Awake timeout each time),
+                    # silently shrinking effective capacity. Leave it out
+                    # of both lists; the operator restores it explicitly.
+                    log.warning(
+                        "rebooted replica %s never complied; dropping it "
+                        "from membership (operator action required)",
+                        byzantine,
+                    )
+                    self.quorum[byzantine] = set()
+                    return
                 self.sentinent.append(byzantine)
                 self.quorum[byzantine] = set()
         finally:
-            self._recovering.difference_update((byzantine, spare))
+            self._recovering.discard(byzantine)
+            if spare is not None:
+                self._recovering.discard(spare)

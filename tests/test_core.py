@@ -808,6 +808,57 @@ def test_he_keys_inline_config_wins_over_path(tmp_path):
     assert p2.keys.psse.n == other.psse.n  # falls back to the file
 
 
+def test_unrecoverable_replica_dropped_not_phantom_spare():
+    """A replica that never complies after redeploy must NOT be listed as
+    a sentinent spare — later recoveries would keep picking a phantom that
+    can never Awake. It is dropped from membership with a loud warning."""
+
+    async def go():
+        c = Cluster()
+        victim = "replica-0"
+        c.supervisor.cfg.sentinent_awake_timeout = 0.2
+        c.supervisor.cfg.crashed_recovery_timeout = 0.2
+
+        async def broken_redeploy(endpoint):
+            pass  # rebuild never happens: node stays gone
+
+        c.supervisor.redeploy = broken_redeploy
+        c.net.unregister(victim)  # hard-dead: Kill and Sleep go nowhere
+        await c.supervisor.recover(victim)
+        active_names = [a for a, _ in c.supervisor.active]
+        assert victim not in active_names
+        assert victim not in c.supervisor.sentinent  # not a phantom spare
+        assert len(active_names) == 7  # a real spare was promoted
+        # the remaining spare is still usable for the NEXT recovery
+        await c.supervisor.recover(active_names[0])
+        assert len([a for a, _ in c.supervisor.active]) == 7
+
+    run(go())
+
+
+def test_dead_spare_dropped_and_next_spare_used():
+    """A spare whose Awake times out is dropped from membership (not kept
+    as a phantom) and recovery proceeds with the next spare in the SAME
+    attempt, so the actual offender still gets swapped out."""
+
+    async def go():
+        c = Cluster()
+        c.supervisor.cfg.sentinent_awake_timeout = 0.2
+        dead_spare = "replica-7"
+        c.net.unregister(dead_spare)  # cannot Awake
+        # deterministic pick order: the dead spare is tried FIRST
+        c.supervisor._rng.choice = lambda seq: sorted(seq)[0]
+        victim = "replica-0"
+        await c.supervisor.recover(victim)
+        assert dead_spare not in c.supervisor.sentinent  # dropped, loudly
+        active_names = [a for a, _ in c.supervisor.active]
+        assert victim not in active_names  # offender really was swapped
+        assert "replica-8" in active_names  # the live spare got promoted
+        assert victim in c.supervisor.sentinent
+
+    run(go())
+
+
 def test_concurrent_suspects_single_recovery():
     async def go():
         c = Cluster()
