@@ -12,11 +12,13 @@ offender (guardian-restart semantics) and re-seed it with the spare's state
 via `Sleep` -> `Complying`, demoting it to sentinent. If the offender's
 host is dead (ask timeout), redeploy a fresh replica at the same endpoint
 through the injected factory and seed that instead. Nodes that prove
-unreachable past their timeouts — a spare that never Awakes, or an
-offender that never Complies after redeploy — are DROPPED from membership
-with a loud warning rather than kept as phantoms (deviation from the
-reference, which would retry them forever); the operator restores them
-explicitly.
+unreachable — a spare that never Awakes, or an offender that never
+Complies after redeploy — accrue strikes; one miss is treated as transient
+(slow restart, supervisor-side blip) and the node stays a (deprioritized)
+spare, but DROP_STRIKES consecutive failures drop it from membership with
+a loud warning rather than keeping a phantom that pins future recoveries
+(deviation from the reference, which would retry forever); the operator
+restores dropped nodes explicitly. Successful contact clears strikes.
 
 Deviations (documented): suspicion voters are the *senders* of Suspect
 votes (the reference seeds the voter set with the suspected node itself,
@@ -73,6 +75,12 @@ class BFTSupervisor:
         self._pending: dict[str, asyncio.Future] = {}
         self._task: Optional[asyncio.Task] = None
         self._recovering: set[str] = set()  # endpoints with recovery in flight
+        # consecutive unreachability strikes (Awake / post-redeploy Sleep
+        # timeouts). One timeout may be transient (slow restart, supervisor-
+        # side blip), so nodes are only DROPPED from membership after
+        # DROP_STRIKES consecutive failures; any successful contact clears
+        # the count. Least-struck spares are preferred for recovery.
+        self._strikes: dict[str, int] = {}
         net.register(addr, self.handle)
 
     # ----------------------------------------------------------- life cycle
@@ -142,6 +150,26 @@ class BFTSupervisor:
 
     # ------------------------------------------------------------- recovery
 
+    DROP_STRIKES = 3
+
+    def _strike(self, endpoint: str, why: str) -> bool:
+        """Record an unreachability strike; True = threshold reached and
+        the endpoint should be dropped from membership (loud warning)."""
+        self._strikes[endpoint] = self._strikes.get(endpoint, 0) + 1
+        if self._strikes[endpoint] >= self.DROP_STRIKES:
+            log.warning(
+                "replica %s %s (%d consecutive failures); dropping it from "
+                "membership (operator action required)",
+                endpoint, why, self._strikes[endpoint],
+            )
+            self._strikes.pop(endpoint, None)
+            return True
+        log.warning(
+            "replica %s %s (strike %d/%d)",
+            endpoint, why, self._strikes[endpoint], self.DROP_STRIKES,
+        )
+        return False
+
     async def recover(self, byzantine: str) -> None:
         """Swap the suspect with a sentinent spare; reseed or redeploy it.
 
@@ -158,30 +186,38 @@ class BFTSupervisor:
             return
         self._recovering.add(byzantine)
         spare = None
+        tried: set[str] = set()
         try:
             while True:
-                spares = [s for s in self.sentinent if s not in self._recovering]
-                if not spares:
+                pool = [
+                    s for s in self.sentinent
+                    if s not in self._recovering and s not in tried
+                ]
+                if not pool:
+                    log.warning(
+                        "no (responsive) spare available to recover %s; "
+                        "it stays active until a spare returns", byzantine,
+                    )
                     return
-                spare = self._rng.choice(spares)
+                # prefer the least-struck spares: recently-unresponsive
+                # ones are retried only when nothing better remains
+                best = min(self._strikes.get(s, 0) for s in pool)
+                spare = self._rng.choice(
+                    [s for s in pool if self._strikes.get(s, 0) == best]
+                )
+                tried.add(spare)
                 self._recovering.add(spare)
                 try:
                     state = await self._ask(
                         spare, M.Awake(), "State",
                         self.cfg.sentinent_awake_timeout,
                     )
+                    self._strikes.pop(spare, None)
                     break
                 except asyncio.TimeoutError:
-                    # a spare that cannot Awake is GONE, not a spare: keep
-                    # it listed and every future recovery re-picks the same
-                    # phantom while the real offender stays active. Drop
-                    # it and try the next spare.
-                    log.warning(
-                        "sentinent %s did not wake up; dropping it from "
-                        "membership (operator action required)", spare,
-                    )
-                    self.sentinent.remove(spare)
                     self._recovering.discard(spare)
+                    if self._strike(spare, "did not wake up"):
+                        self.sentinent.remove(spare)
                     spare = None
 
             # promote the spare
@@ -199,6 +235,7 @@ class BFTSupervisor:
                     "Complying",
                     self.cfg.sentinent_awake_timeout,
                 )
+                self._strikes.pop(byzantine, None)
                 self.sentinent.append(byzantine)
                 self.quorum[byzantine] = set()
             except asyncio.TimeoutError:
@@ -216,19 +253,17 @@ class BFTSupervisor:
                         "Complying",
                         self.cfg.crashed_recovery_timeout,
                     )
+                    self._strikes.pop(byzantine, None)
                 except asyncio.TimeoutError:
-                    # A node that never complied after a redeploy is GONE,
-                    # not a spare: listing it as sentinent would make later
-                    # recoveries pick a phantom (Awake timeout each time),
-                    # silently shrinking effective capacity. Leave it out
-                    # of both lists; the operator restores it explicitly.
-                    log.warning(
-                        "rebooted replica %s never complied; dropping it "
-                        "from membership (operator action required)",
-                        byzantine,
-                    )
-                    self.quorum[byzantine] = set()
-                    return
+                    # One miss may just be a slow restart: keep it as a
+                    # (struck) spare so it self-heals when it comes back.
+                    # Persistent unreachability accrues strikes — here or
+                    # when it is later retried as a spare — and only then
+                    # is it dropped, so phantoms cannot pin recoveries
+                    # forever yet a transient blip costs nothing.
+                    if self._strike(byzantine, "never complied after reboot"):
+                        self.quorum[byzantine] = set()
+                        return
                 self.sentinent.append(byzantine)
                 self.quorum[byzantine] = set()
         finally:

@@ -808,10 +808,11 @@ def test_he_keys_inline_config_wins_over_path(tmp_path):
     assert p2.keys.psse.n == other.psse.n  # falls back to the file
 
 
-def test_unrecoverable_replica_dropped_not_phantom_spare():
-    """A replica that never complies after redeploy must NOT be listed as
-    a sentinent spare — later recoveries would keep picking a phantom that
-    can never Awake. It is dropped from membership with a loud warning."""
+def test_unreachable_replica_struck_then_dropped():
+    """A replica that never complies after redeploy stays a (struck) spare
+    — one miss may be a slow restart — but DROP_STRIKES consecutive
+    failures drop it from membership so a phantom cannot pin future
+    recoveries. A transient single miss self-heals on the next contact."""
 
     async def go():
         c = Cluster()
@@ -827,34 +828,50 @@ def test_unrecoverable_replica_dropped_not_phantom_spare():
         await c.supervisor.recover(victim)
         active_names = [a for a, _ in c.supervisor.active]
         assert victim not in active_names
-        assert victim not in c.supervisor.sentinent  # not a phantom spare
-        assert len(active_names) == 7  # a real spare was promoted
-        # the remaining spare is still usable for the NEXT recovery
+        assert len(active_names) == 7           # a real spare was promoted
+        # strike 1: kept as a spare (could be a slow restart)
+        assert victim in c.supervisor.sentinent
+        assert c.supervisor._strikes[victim] == 1
+        # once it is the ONLY spare left, it gets retried and keeps
+        # failing Awake: strikes 2, 3 -> dropped
+        c.supervisor.sentinent = [victim]
         await c.supervisor.recover(active_names[0])
-        assert len([a for a, _ in c.supervisor.active]) == 7
+        assert c.supervisor._strikes[victim] == 2
+        assert victim in c.supervisor.sentinent  # still quarantined-spare
+        await c.supervisor.recover(active_names[0])
+        assert victim not in c.supervisor.sentinent  # dropped, loudly
+        assert victim not in [a for a, _ in c.supervisor.active]
+        assert victim not in c.supervisor._strikes  # bookkeeping cleared
 
     run(go())
 
 
-def test_dead_spare_dropped_and_next_spare_used():
-    """A spare whose Awake times out is dropped from membership (not kept
-    as a phantom) and recovery proceeds with the next spare in the SAME
-    attempt, so the actual offender still gets swapped out."""
+def test_dead_spare_deprioritized_and_next_spare_used():
+    """A spare whose Awake times out earns a strike and recovery proceeds
+    with the next spare in the SAME attempt, so the offender still gets
+    swapped; the struck spare is deprioritized for later picks but NOT
+    dropped on a single miss."""
 
     async def go():
         c = Cluster()
         c.supervisor.cfg.sentinent_awake_timeout = 0.2
         dead_spare = "replica-7"
         c.net.unregister(dead_spare)  # cannot Awake
-        # deterministic pick order: the dead spare is tried FIRST
+        # deterministic pick order among equal-strike spares
         c.supervisor._rng.choice = lambda seq: sorted(seq)[0]
         victim = "replica-0"
         await c.supervisor.recover(victim)
-        assert dead_spare not in c.supervisor.sentinent  # dropped, loudly
+        # single miss: still a spare, but struck
+        assert dead_spare in c.supervisor.sentinent
+        assert c.supervisor._strikes[dead_spare] == 1
         active_names = [a for a, _ in c.supervisor.active]
         assert victim not in active_names  # offender really was swapped
         assert "replica-8" in active_names  # the live spare got promoted
         assert victim in c.supervisor.sentinent
+        # later recoveries prefer the unstruck spare over the struck one
+        await c.supervisor.recover(active_names[0])
+        assert dead_spare in c.supervisor.sentinent  # was not even tried
+        assert c.supervisor._strikes[dead_spare] == 1
 
     run(go())
 
