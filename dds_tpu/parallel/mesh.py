@@ -76,13 +76,23 @@ def _tree_reduce_local(cs, N, n0inv, one_mont):
     return t
 
 
-def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch"):
+def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch",
+                       ring: bool = False):
     """Modular product of K ciphertexts sharded over `mesh`.
 
     cs: (K, L) plain-domain, K divisible by mesh size times 1 (padded here
     to a power of two per shard with the Montgomery identity, like
     ModCtx.reduce_mul). Returns (1, L) = prod(cs) * R^-(K-1) mod n,
     replicated; callers fix the R power exactly as ModCtx.reduce_mul does.
+
+    Two combine collectives, same result and R accounting (D partials,
+    D-1 montgomery multiplies either way):
+    - ring=False: ONE all_gather of the (D, L) partials + a replicated
+      tail tree — best here because the payload is tiny (L limbs/device);
+    - ring=True: D-1 `ppermute` neighbor hops, each device multiplying the
+      partial circulating past it — the ring-attention-style ICI pattern
+      that wins when per-device payloads are large enough that an
+      all_gather would burst-buffer D copies at once.
     """
     D = mesh.devices.size
     K = cs.shape[0]
@@ -93,16 +103,27 @@ def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch"):
         pad = jnp.broadcast_to(jnp.asarray(ctx.one_mont), (total - K, ctx.L))
         cs = jnp.concatenate([jnp.asarray(cs), pad], axis=0)
 
-    key = ("reduce", ctx.n, mesh, axis)
+    key = ("reduce", ctx.n, mesh, axis, ring)
     fn = _FN_CACHE.get(key)
     if fn is None:
         N = jnp.asarray(ctx.N)
         n0inv = jnp.uint32(ctx.n0inv)
         one_mont = jnp.asarray(ctx.one_mont)
+        perm = [(d, (d + 1) % D) for d in range(D)]
 
         def step(local):
             # local: (P2, L) on each device
             partial = _tree_reduce_local(local, N, n0inv, one_mont)   # (1, L)
+            if ring:
+                def hop(_, acc_msg):
+                    acc, msg = acc_msg
+                    msg = jax.lax.ppermute(msg, axis, perm)
+                    return _mont_mul_raw(acc, msg, N, n0inv), msg
+
+                acc, _ = jax.lax.fori_loop(
+                    0, D - 1, hop, (partial, partial)
+                )
+                return acc  # equal on every device after D-1 hops
             partials = jax.lax.all_gather(partial, axis, tiled=True)  # (D, L)
             return _tree_reduce_local(partials, N, n0inv, one_mont)   # (1, L) replicated
 
@@ -119,10 +140,11 @@ def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch"):
     return fn(cs)
 
 
-def sharded_reduce_mul_fixed(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch"):
+def sharded_reduce_mul_fixed(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch",
+                             ring: bool = False):
     """Like ModCtx.reduce_mul but mesh-sharded: returns prod(cs) mod n (1, L)."""
     K = cs.shape[0]
-    prod = sharded_reduce_mul(ctx, cs, mesh, axis)
+    prod = sharded_reduce_mul(ctx, cs, mesh, axis, ring)
     R = 1 << (bn.LIMB_BITS * ctx.L)
     fix = bn.int_to_limbs(pow(R % ctx.n, K, ctx.n), ctx.L)
     return ctx.mont_mul(prod, jnp.asarray(fix)[None, :])

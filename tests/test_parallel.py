@@ -33,6 +33,23 @@ def test_sharded_reduce_mul_matches_int(K):
     assert bn.limbs_to_int(np.asarray(out)[0]) == want
 
 
+@pytest.mark.parametrize("K", [8, 16, 37])
+def test_ring_combine_matches_allgather(K):
+    """The ppermute ring combine (ring-attention-style neighbor hops) must
+    produce exactly the all_gather tree's result — same product, same
+    Montgomery R accounting (D-1 multiplies either way)."""
+    n = rng.getrandbits(512) | (1 << 511) | 1
+    ctx = ModCtx.make(n)
+    mesh = make_mesh(8)
+    cs_int = [rng.randrange(n) for _ in range(K)]
+    cs = bn.ints_to_batch(cs_int, ctx.L)
+    out = sharded_reduce_mul_fixed(ctx, cs, mesh, ring=True)
+    want = 1
+    for c in cs_int:
+        want = want * c % n
+    assert bn.limbs_to_int(np.asarray(out)[0]) == want
+
+
 def test_sharded_pow_mod_matches_int():
     n = rng.getrandbits(256) | (1 << 255) | 1
     ctx = ModCtx.make(n)
