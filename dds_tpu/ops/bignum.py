@@ -55,6 +55,14 @@ def limbs_to_int(arr) -> int:
     return out
 
 
+def ones_batch(B: int, L: int) -> np.ndarray:
+    """(B, L) limb batch of the integer 1 — the shared identity used by
+    the modexp shells (exp == 0 results, from-Montgomery epilogues)."""
+    out = np.zeros((B, L), np.uint32)
+    out[:, 0] = 1
+    return out
+
+
 def ints_to_batch(xs, L: int) -> np.ndarray:
     """List of python ints -> (B, L) uint32 limb batch."""
     return np.stack([int_to_limbs(x, L) for x in xs], axis=0)

@@ -382,8 +382,6 @@ def pow_mod(ctx: ModCtx, bases, exp: int, interpret: bool | None = None):
     if interpret is None:
         interpret = _interpret_default()
     if exp == 0:
-        out = np.zeros((bases.shape[0], ctx.L), np.uint32)
-        out[:, 0] = 1
-        return jnp.asarray(out)
+        return jnp.asarray(bn.ones_batch(bases.shape[0], ctx.L))
     digits = jnp.asarray(_exp_to_digits(exp).astype(np.int32))
     return _pow_fn(ctx, int(digits.shape[0]), interpret)(jnp.asarray(bases), digits)

@@ -126,3 +126,34 @@ def test_reduce_mul2_matches_python_and_v1():
         assert got2 == want, f"v2 fold wrong at K={K}"
         got1 = bn.batch_to_ints(np.asarray(pm.reduce_mul(ctx, batch, interpret=True)))[0]
         assert got1 == want, f"v1 fold wrong at K={K}"
+
+
+@pytest.mark.parametrize("bits,ebits", [(256, 17), (256, 64), (512, 130)])
+def test_pow_mod2_matches_python(bits, ebits):
+    """v2 windowed modexp ladder (table + scan over mul2_lm) vs pow()."""
+    import random
+
+    from dds_tpu.ops import mont_mxu as mx
+
+    rng = random.Random(bits * 1000 + ebits)
+    n = rng.getrandbits(bits) | (1 << (bits - 1)) | 1
+    ctx = ModCtx.make(n)
+    mctx = mx.MxuCtx.make(ctx)
+    bases = [rng.randrange(1, n) for _ in range(5)]
+    exp = rng.getrandbits(ebits) | 1
+    out = mx.pow_mod2(mctx, bn.ints_to_batch(bases, ctx.L), exp)
+    assert bn.batch_to_ints(np.asarray(out)) == [pow(b, exp, n) for b in bases]
+
+
+def test_pow_mod2_zero_exponent():
+    import random
+
+    from dds_tpu.ops import mont_mxu as mx
+
+    rng = random.Random(77)
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    ctx = ModCtx.make(n)
+    mctx = mx.MxuCtx.make(ctx)
+    bases = [rng.randrange(1, n) for _ in range(3)]
+    out = mx.pow_mod2(mctx, bn.ints_to_batch(bases, ctx.L), 0)
+    assert bn.batch_to_ints(np.asarray(out)) == [1, 1, 1]
