@@ -207,11 +207,23 @@ class TcpNet(Transport):
             except asyncio.TimeoutError:
                 pass
 
+    # max inbound frame (reference: akka maximum-frame-size = 30 MB,
+    # dds-system.conf:58): a peer declaring a huge length must not make
+    # the receiver buffer it
+    MAX_FRAME = 32 * 1024 * 1024
+
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             while True:
                 hdr = await reader.readexactly(4)
-                frame = await reader.readexactly(int.from_bytes(hdr, "big"))
+                size = int.from_bytes(hdr, "big")
+                if size > self.MAX_FRAME:
+                    log.warning(
+                        "dropping connection from %s: %d-byte frame declared",
+                        writer.get_extra_info("peername"), size,
+                    )
+                    break
+                frame = await reader.readexactly(size)
                 import json
 
                 obj = json.loads(frame)
@@ -288,6 +300,15 @@ class TcpNet(Transport):
                 if self._node_key is not None:
                     obj["sig"] = self._node_key.sign(body).hex()
             frame = json.dumps(obj).encode()
+            if len(frame) > self.MAX_FRAME:
+                # symmetric with the receive bound: sending it anyway would
+                # get the shared cached connection killed at the receiver,
+                # silently losing queued frames behind it
+                log.error(
+                    "refusing to send %d-byte frame %s -> %s (MAX_FRAME %d)",
+                    len(frame), src, dest, self.MAX_FRAME,
+                )
+                return
             w.write(len(frame).to_bytes(4, "big") + frame)
             await w.drain()
         except OSError:

@@ -359,6 +359,39 @@ def test_tcp_intranet_mutual_tls_rejects_certless_peer(tmp_path):
     run(go())
 
 
+def test_oversized_tcp_frame_drops_connection():
+    """A peer declaring a frame above MAX_FRAME (reference parity:
+    maximum-frame-size, dds-system.conf:58) gets its connection dropped
+    before the receiver buffers anything; normal traffic still flows."""
+
+    async def go():
+        from dds_tpu.core.transport import TcpNet
+
+        net = TcpNet("127.0.0.1", 39551)
+        await net.start()
+        got = []
+
+        async def handler(sender, msg):
+            got.append(msg)
+
+        net.register("127.0.0.1:39551/sup", handler)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", 39551)
+            w.write((TcpNet.MAX_FRAME + 1).to_bytes(4, "big") + b"x" * 64)
+            await w.drain()
+            # the server DROPS the connection (not just the frame): EOF
+            assert await asyncio.wait_for(r.read(1), 2) == b""
+            # a fresh, sane frame on a new connection still works
+            net.send("a", "127.0.0.1:39551/sup", M.ReadTag("k", 1))
+            await asyncio.sleep(0.2)
+            w.close()
+            assert [type(m).__name__ for m in got] == ["ReadTag"]
+        finally:
+            await net.stop()
+
+    run(go())
+
+
 def test_node_signed_frames_reject_credentialed_src_forgery():
     """Per-node frame signatures (utils/nodeauth): member B holds VALID
     cluster credentials (its own Ed25519 key, registered in the registry)
