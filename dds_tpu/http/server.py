@@ -1,8 +1,9 @@
 """REST proxy: the 23-route encrypted query engine.
 
-Counterpart of `dds/http/DDSRestServer.scala:153-948` — same route names,
-parameters, JSON shapes and status codes — rebuilt around two TPU-first
-ideas the reference lacks:
+Counterpart of `dds/http/DDSRestServer.scala:153-948` — same 23 route
+names, parameters, JSON shapes and status codes (plus one addition of
+ours: GET /_trace, the live tracing summary) — rebuilt around two
+TPU-first ideas the reference lacks:
 
 - all ciphertext arithmetic goes through the pluggable `CryptoBackend`
   (cpu | tpu); aggregate folds (`SumAll`, `MultAll`) become ONE batched
@@ -73,6 +74,12 @@ class ProxyConfig:
     key_sync_warmup: float = 1.0
     key_sync_interval: float = 5.0
     peers: list[str] = field(default_factory=list)  # "host:port"
+    # GET /_trace observability route. Default OFF: it reveals workload
+    # shape (route counts, latencies, store size) to anyone who can reach
+    # the client-facing listener — the reference gates observability
+    # behind debug flags too (dds-system.conf:61-62). launch() enables it
+    # for debug deployments.
+    trace_route_enabled: bool = False
     # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
     replica_refresh_interval: float = 5.0
     supervisor: Optional[str] = None
@@ -577,6 +584,15 @@ class DDSRestServer:
                 for k in J.parse_keys(req.json()):
                     self._note_stored(k)
                 return Response(204)
+
+            case ("GET", "_trace") if self.cfg.trace_route_enabled:
+                # live observability (SURVEY §5.5): per-span timing summary
+                # (count/total/mean/p50/p95 ms) + counters from utils/trace.
+                # Config-gated (reveals workload shape); no ciphertexts or
+                # keys leave — span metadata is aggregate timing only.
+                return Response.json(
+                    {"spans": tracer.summary(), "stored_keys": len(self.stored_keys)}
+                )
 
         return Response(404)
 

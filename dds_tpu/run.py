@@ -290,6 +290,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             key_sync_interval=cfg.proxy.key_sync_interval,
             peers=cfg.proxy.remote_peers,
             supervisor=sup_addr,
+            trace_route_enabled=cfg.debug,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),

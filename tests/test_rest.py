@@ -382,3 +382,30 @@ def test_sumall_executes_sharded_on_mesh(monkeypatch):
             assert calls["n"] >= 1  # the fold actually went through the mesh
 
     asyncio.run(go())
+
+
+def test_trace_route_reports_span_summary():
+    """GET /_trace exposes the live tracer summary: after a PutSet and a
+    GetSet, the quorum spans appear with counts and millisecond stats."""
+
+    async def go():
+        async with rest_stack() as (server, _, _):
+            from dds_tpu.utils.trace import tracer
+
+            tracer.reset()
+            row = PROVIDER.encrypt_row([5], 1, ["PSSE"])
+            _, key = await call(server, "POST", "/PutSet", {"contents": row})
+            await call(server, "GET", f"/GetSet/{key.decode()}")
+            status, _ = await call(server, "GET", "/_trace")
+            assert status == 404  # gated off by default (workload shape)
+            server.cfg.trace_route_enabled = True
+            status, data = await call(server, "GET", "/_trace")
+            assert status == 200
+            body = json.loads(data)
+            assert body["stored_keys"] == 1
+            spans = body["spans"]
+            assert spans["abd.write"]["count"] >= 1
+            assert spans["abd.fetch"]["count"] >= 1
+            assert spans["http.POST.PutSet"]["mean_ms"] > 0
+
+    asyncio.run(go())
