@@ -811,6 +811,23 @@ def test_he_key_persistence_roundtrip(tmp_path):
             total = int(_json.loads(body)["result"])
             assert run2.keys.psse.decrypt_signed(total) == sum(vals)
 
+            # and literally from a FRESH PROCESS: only the persisted key
+            # file crosses the boundary
+            import subprocess
+            import sys
+
+            out = subprocess.run(
+                [sys.executable, "-c", (
+                    "import sys\n"
+                    "from dds_tpu.models.keys import HEKeys\n"
+                    "k = HEKeys.from_json(open(sys.argv[1]).read())\n"
+                    "print(k.psse.decrypt_signed(int(sys.argv[2])))\n"
+                ), cfg.client.he_keys_path, str(total)],
+                capture_output=True, text=True, timeout=120,
+            )
+            assert out.returncode == 0, out.stderr
+            assert int(out.stdout.strip()) == sum(vals)
+
             stranger = HomoProvider.generate(1024, 1024)
             assert stranger.keys.psse.decrypt_signed(total) != sum(vals)
         finally:
