@@ -83,10 +83,10 @@ class TpuBackend:
         import os
 
         self.pallas = _use_pallas() if pallas is None else pallas
-        # Fold kernel: "v2" = schoolbook product + MXU band-matmul REDC
-        # (ops/mont_mxu), "v1" = fused CIOS (ops/pallas_mont). v2 wins on
-        # TPU hardware (see benchmarks/kernel_compare.py); DDS_KERNEL
-        # overrides.
+        # Kernel family for folds AND batch modexp: "v2" = schoolbook
+        # product + MXU band-matmul REDC (ops/mont_mxu), "v1" = fused CIOS
+        # (ops/pallas_mont). v2 wins both ops on TPU hardware (see
+        # benchmarks/kernel_compare.py); DDS_KERNEL overrides both.
         self.kernel = (
             kernel if kernel is not None else os.environ.get("DDS_KERNEL", "v2")
         ).strip().lower()
@@ -208,15 +208,17 @@ class TpuBackend:
             out = pm.sharded_pow_mod(ctx, batch, _exp_to_digits(exp), mesh)
             return bn.batch_to_ints(np.asarray(out)[:B])
         if self.pallas:
-            # modexp stays on the v1 fused ladder even when folds use v2:
-            # the whole square-and-multiply chain runs inside ONE kernel
-            # with VMEM-resident state, which wins sustained throughput
-            # (measured 12.7 vs 15.8 ms @ B=256/L=256/64-bit exp) — v2's
-            # per-multiply HBM round-trips only win single-dispatch
-            # latency (48 vs 84 ms; see ops/mont_mxu.pow_mod2).
-            from dds_tpu.ops import pallas_mont
+            if self.kernel == "v2":
+                # v2 wins modexp in both regimes (benchmarks/kernel_compare,
+                # back-to-back on a v5e: sustained 7.5 vs 12.7 ms, single
+                # dispatch 48 vs 84 ms @ B=256/L=256/64-bit exp)
+                from dds_tpu.ops import mont_mxu
 
-            out = pallas_mont.pow_mod(ctx, batch, exp)
+                out = mont_mxu.pow_mod2(mont_mxu.MxuCtx.make(ctx), batch, exp)
+            else:
+                from dds_tpu.ops import pallas_mont
+
+                out = pallas_mont.pow_mod(ctx, batch, exp)
         else:
             out = ctx.pow_mod(batch, exp)
         return bn.batch_to_ints(np.asarray(out))

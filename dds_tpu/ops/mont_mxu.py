@@ -373,13 +373,13 @@ def pow_mod2(mctx: MxuCtx, bases, exp: int, interpret: bool | None = None):
     """Plain-domain bases^exp mod n via the v2 multiply; (B, L) in/out.
     Contract identical to pallas_mont.pow_mod / ModCtx.pow_mod.
 
-    Trade-off vs the v1 fused ladder (measured @ B=256, L=256, 64-bit
-    exp on v5e): ~1.75x LOWER single-dispatch latency (48 vs 84 ms — the
-    MXU REDC does less VPU work), but ~25% lower sustained throughput
-    (15.8 vs 12.7 ms/batch) because every multiply round-trips HBM where
-    v1 keeps the whole chain VMEM-resident in one kernel. The serving
-    backend therefore defaults to v1 for batch modexp; use this variant
-    where per-call latency matters more than pipelined throughput."""
+    vs the v1 fused ladder (back-to-back on a v5e @ B=256, L=256, 64-bit
+    exp, benchmarks/kernel_compare): ~1.7x faster sustained (7.5 vs
+    12.7 ms/batch) and ~1.75x lower single-dispatch latency (48 vs 84 ms)
+    — the MXU REDC removes most of the VPU multiply work, which outweighs
+    the per-multiply HBM round-trips v1 avoids by keeping its chain
+    VMEM-resident. The serving backend uses this variant whenever folds
+    use v2 (the TPU default)."""
     from dds_tpu.ops.montgomery import _exp_to_digits
 
     if interpret is None:
