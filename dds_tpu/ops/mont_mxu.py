@@ -142,6 +142,83 @@ def prod_lm(a, b, TB: int = PROD_TB, interpret: bool | None = None):
     return _prod_call(Lp, a.shape[1], TB, interpret)(a, b)[: 2 * L, :B]
 
 
+def prod_lm_k1(a, b, TB: int = PROD_TB, interpret: bool | None = None):
+    """One Karatsuba level over prod_lm: 3 half-size schoolbook products
+    instead of 1 full-size one — 25% fewer VPU u32 multiplies, the v2
+    kernel's dominant cost. Composed entirely from existing primitives:
+
+        a = a0 + a1*X, b = b0 + b1*X  with X = 2^(16h), h = L/2
+        T = z0 + [z1 - z0 - z2]*X + z2*X^2,  z1 = (a0+a1)(b0+b1)
+
+    The half sums are carry-normalized into canonical h-limb digits plus a
+    0/1 overflow bit each (the bit's cross terms are cheap masked adds), so
+    the half-size products stay within prod_lm's 16-bit-digit contract.
+    The middle-term subtraction runs borrow-free as a complement add: with
+    rows = 2h+1 and canonical z0c/z2c,
+        t = z1_full + comp(z0c) + comp(z2c) + 2
+          = mid + 2*2^(16*rows)
+    so after carry_norm the carry-out is exactly 2 and the canonical
+    digits ARE the middle term. Digit bounds: every accumulated vector
+    stays < 2^27, far under carry_norm's 2^31 input bound.
+
+    Returns the same (2L, B) redundant accumulator shape as prod_lm; only
+    the digit decomposition differs, which _redc's carry normalization
+    absorbs. Requires L even (all supported key sizes; falls back to
+    prod_lm otherwise).
+
+    MEASURED VERDICT (v5e, sustained fold): the 25% multiply saving does
+    not survive the extra dispatches + combine passes — 3.6% SLOWER at
+    L=256 (16.9 vs 16.3 ms @ K=32768) and only 2.5% faster at L=512
+    (14.0 vs 14.3 ms @ K=8192). Kept flag-gated (DDS_KARATSUBA=1) as a
+    correctness-tested experiment and as the record of why the default
+    stays plain schoolbook; a win here needs in-kernel Karatsuba (one
+    dispatch), not composition."""
+    L = a.shape[0]
+    if L % 2:
+        return prod_lm(a, b, TB, interpret)
+    h = L // 2
+    a0, a1 = a[:h], a[h:]
+    b0, b1 = b[:h], b[h:]
+    z0 = prod_lm(a0, b0, TB, interpret)                    # (2h, B)
+    z2 = prod_lm(a1, b1, TB, interpret)                    # (2h, B)
+    sa, ca = carry_norm(a0 + a1)                           # (h,B), (1,B) in {0,1}
+    sb, cb = carry_norm(b0 + b1)
+    z1 = prod_lm(sa, sb, TB, interpret)                    # (2h, B)
+    rows = 2 * h + 1
+    B = a.shape[1]
+    # z1_full = (sa + ca*X)(sb + cb*X) over `rows` digits: cross terms are
+    # the 0/1-masked canonical halves shifted h limbs, plus ca*cb at 2h
+    z1f = jnp.zeros((rows, B), jnp.uint32)
+    z1f = z1f.at[: 2 * h].add(z1)
+    z1f = z1f.at[h : 2 * h].add(sb * ca)
+    z1f = z1f.at[h : 2 * h].add(sa * cb)
+    z1f = z1f.at[2 * h].add((ca * cb)[0])
+    # borrow-free middle term: complement-add the canonicalized z0/z2
+    z0c, c0 = carry_norm(z0)
+    z2c, c2 = carry_norm(z2)
+    # products < 2^(32h): the carry past 2h rows is provably zero
+    del c0, c2
+    comp0 = jnp.pad(MASK16 - z0c, ((0, 1), (0, 0)), constant_values=0xFFFF)
+    comp2 = jnp.pad(MASK16 - z2c, ((0, 1), (0, 0)), constant_values=0xFFFF)
+    t = z1f + comp0 + comp2
+    t = t.at[0:1].add(2)
+    mid, cout = carry_norm(t)
+    del cout  # always exactly 2 (see docstring); digits carry the value
+    # assemble T = z0 + mid*X + z2*X^2 into the (2L, B) accumulator
+    T = jnp.zeros((2 * L, B), jnp.uint32)
+    T = T.at[: 2 * h].add(z0c)
+    T = T.at[h : h + rows].add(mid)
+    T = T.at[2 * h :].add(z2c)
+    return T
+
+
+def _use_karatsuba() -> bool:
+    import os
+
+    flag = os.environ.get("DDS_KARATSUBA", "").strip().lower()
+    return bool(flag) and flag not in ("0", "false", "off", "no")
+
+
 # ---------------------------------------------------------------------------
 # XLA carry normalization (Kogge-Stone) in base 2^16 or 2^8
 # ---------------------------------------------------------------------------
@@ -320,9 +397,16 @@ def _redc(mctx: MxuCtx, T):
     return jnp.where(take_diff, diff, t)
 
 
-def mul2_lm(mctx: MxuCtx, a, b, interpret: bool | None = None):
-    """Montgomery product a*b*R^-1 mod n, limbs-major (L, B) canonical."""
-    T = prod_lm(a, b, interpret=interpret)
+def mul2_lm(mctx: MxuCtx, a, b, interpret: bool | None = None,
+            karatsuba: bool | None = None):
+    """Montgomery product a*b*R^-1 mod n, limbs-major (L, B) canonical.
+
+    `karatsuba` must be passed EXPLICITLY by traced callers (their jit
+    caches key on it); None reads the DDS_KARATSUBA env flag."""
+    if _use_karatsuba() if karatsuba is None else karatsuba:
+        T = prod_lm_k1(a, b, interpret=interpret)
+    else:
+        T = prod_lm(a, b, interpret=interpret)
     return _redc(mctx, T)
 
 
@@ -332,14 +416,15 @@ def mul2_lm(mctx: MxuCtx, a, b, interpret: bool | None = None):
 
 
 @functools.lru_cache(maxsize=None)
-def _pow2_fn(mctx: MxuCtx, E: int, interpret: bool):
+def _pow2_fn(mctx: MxuCtx, E: int, interpret: bool, karatsuba: bool):
     ctx = mctx.ctx
+    mul = functools.partial(mul2_lm, karatsuba=karatsuba)
 
     def run(bases, digits):
         x = bases.T                                           # (L, B)
         shape = x.shape
         r2 = jnp.broadcast_to(jnp.asarray(ctx.R2)[:, None], shape)
-        xm = mul2_lm(mctx, x, r2, interpret)                  # to mont
+        xm = mul(mctx, x, r2, interpret)                  # to mont
         onem = jnp.broadcast_to(
             jnp.asarray(ctx.one_mont)[:, None], shape
         ).astype(jnp.uint32)
@@ -348,14 +433,14 @@ def _pow2_fn(mctx: MxuCtx, E: int, interpret: bool):
         # scan body stays branch-free)
         tab = [onem, xm]
         for _ in range(2, 16):
-            tab.append(mul2_lm(mctx, tab[-1], xm, interpret))
+            tab.append(mul(mctx, tab[-1], xm, interpret))
         table = jnp.stack(tab, axis=0)                        # (16, L, B)
         acc = jnp.take(table, digits[0], axis=0)
 
         def step(acc, d):
             for _ in range(4):                                # window bits
-                acc = mul2_lm(mctx, acc, acc, interpret)
-            acc = mul2_lm(mctx, acc, jnp.take(table, d, axis=0), interpret)
+                acc = mul(mctx, acc, acc, interpret)
+            acc = mul(mctx, acc, jnp.take(table, d, axis=0), interpret)
             return acc, None
 
         if E > 1:
@@ -387,21 +472,21 @@ def pow_mod2(mctx: MxuCtx, bases, exp: int, interpret: bool | None = None):
     if exp == 0:
         return jnp.asarray(bn.ones_batch(bases.shape[0], mctx.ctx.L))
     digits = jnp.asarray(_exp_to_digits(exp).astype(np.int32))
-    return _pow2_fn(mctx, int(digits.shape[0]), interpret)(
+    return _pow2_fn(mctx, int(digits.shape[0]), interpret, _use_karatsuba())(
         jnp.asarray(bases), digits
     )
 
 
 @functools.lru_cache(maxsize=None)
-def _reduce2_fn(mctx: MxuCtx, P2: int, interpret: bool):
+def _reduce2_fn(mctx: MxuCtx, P2: int, interpret: bool, karatsuba: bool):
     def run(cs, fix):
         x = cs.T
         w = P2
         while w > 1:
             h = w // 2
-            x = mul2_lm(mctx, x[:, :h], x[:, h : 2 * h], interpret)
+            x = mul2_lm(mctx, x[:, :h], x[:, h : 2 * h], interpret, karatsuba)
             w = h
-        x = mul2_lm(mctx, x[:, :1], fix[:, None], interpret)
+        x = mul2_lm(mctx, x[:, :1], fix[:, None], interpret, karatsuba)
         return x[:, :1].T
 
     return jax.jit(run)
@@ -422,4 +507,6 @@ def reduce_mul2(mctx: MxuCtx, cs, interpret: bool | None = None):
     if P2 != K:
         pad = jnp.broadcast_to(jnp.asarray(ctx.one_mont), (P2 - K, ctx.L))
         cs = jnp.concatenate([cs, pad], axis=0)
-    return _reduce2_fn(mctx, P2, interpret)(cs, _fold_fix(ctx, K))
+    return _reduce2_fn(mctx, P2, interpret, _use_karatsuba())(
+        cs, _fold_fix(ctx, K)
+    )
