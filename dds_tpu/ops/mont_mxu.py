@@ -65,34 +65,42 @@ def _interpret_default() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _make_prod_kernel(L: int, TB: int):
-    """T = a*b as redundant base-2^16 digits, limbs-major.
+def _accumulate_prod(a_ref, b_ref, acc_ref, rows: int, TB: int) -> None:
+    """Schoolbook-accumulate a_ref*b_ref ((rows, TB) each, canonical
+    16-bit digits) into acc_ref ((2*rows + GROUP, TB), pre-zeroed).
 
-    Accumulates GROUP shifted partial products per loop step so the
-    dynamic accumulator update stays sublane-aligned. Digit bound: each
-    position sums <= L lo-halves + L hi-halves, each < 2^16, so digits
-    < 2*L*2^16 = 2^26 for L = 512 (Paillier-4096) — comfortably below
-    u32 and carry_norm's < 2^31 input bound; no carries inside the loop.
-    """
+    GROUP shifted partial products per loop step keep the dynamic
+    accumulator update sublane-aligned; the pad offsets (j / GROUP-j for
+    the lo halves, j+1 / GROUP-j-1 for the hi halves) encode the digit
+    alignment. Digit bound: each position sums <= rows lo-halves + rows
+    hi-halves, each < 2^16, so digits < 2*rows*2^16 = 2^26 for rows = 512
+    (Paillier-4096) — comfortably below u32 and carry_norm's < 2^31 input
+    bound; no carries inside the loop."""
+    b = b_ref[:, :]
+
+    def body(g, _):
+        base = g * GROUP
+        w = jnp.zeros((rows + GROUP, TB), jnp.uint32)
+        for j in range(GROUP):
+            p = a_ref[pl.ds(base + j, 1), :] * b          # (rows, TB)
+            lo = jnp.pad(p & MASK16, ((j, GROUP - j), (0, 0)))
+            hi = jnp.pad(p >> LIMB_BITS, ((j + 1, GROUP - j - 1), (0, 0)))
+            w = w + lo + hi
+        cur = acc_ref[pl.ds(base, rows + GROUP), :]
+        acc_ref[pl.ds(base, rows + GROUP), :] = cur + w
+        return 0
+
+    jax.lax.fori_loop(0, rows // GROUP, body, 0)
+
+
+def _make_prod_kernel(L: int, TB: int):
+    """T = a*b as redundant base-2^16 digits, limbs-major (see
+    _accumulate_prod for the scheme + digit bounds)."""
     Lacc = 2 * L + GROUP  # top pad so every (L+GROUP)-row update fits
 
     def kernel(a_ref, b_ref, out_ref, acc_ref):
         acc_ref[:, :] = jnp.zeros((Lacc, TB), jnp.uint32)
-        b = b_ref[:, :]
-
-        def body(g, _):
-            base = g * GROUP
-            w = jnp.zeros((L + GROUP, TB), jnp.uint32)
-            for j in range(GROUP):
-                p = a_ref[pl.ds(base + j, 1), :] * b      # (L, TB)
-                lo = jnp.pad(p & MASK16, ((j, GROUP - j), (0, 0)))
-                hi = jnp.pad(p >> LIMB_BITS, ((j + 1, GROUP - j - 1), (0, 0)))
-                w = w + lo + hi
-            cur = acc_ref[pl.ds(base, L + GROUP), :]
-            acc_ref[pl.ds(base, L + GROUP), :] = cur + w
-            return 0
-
-        jax.lax.fori_loop(0, L // GROUP, body, 0)
+        _accumulate_prod(a_ref, b_ref, acc_ref, L, TB)
         out_ref[:, :] = acc_ref[0 : 2 * L, :]
 
     return kernel
@@ -111,6 +119,41 @@ def _prod_call(L: int, B: int, TB: int, interpret: bool):
         out_specs=pl.BlockSpec((2 * L, TB), lambda i: (0, i), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((2 * L, B), jnp.uint32),
         scratch_shapes=[pltpu.VMEM((2 * L + GROUP, TB), jnp.uint32)],
+        interpret=interpret,
+    )
+
+
+def _make_prod3_kernel(h: int, TB: int):
+    """Three independent (h, TB) x (h, TB) schoolbook products in ONE
+    kernel dispatch, outputs stacked as (6h, TB): the fused Karatsuba
+    product (z0 | z2 | z1-of-half-sums) without the per-product dispatch
+    + HBM round-trips that sank the composed variant. Same digit bounds
+    as _make_prod_kernel at half the row count."""
+
+    def kernel(a0_ref, b0_ref, a1_ref, b1_ref, sa_ref, sb_ref, out_ref, acc_ref):
+        for idx, (a_ref, b_ref) in enumerate(
+            ((a0_ref, b0_ref), (a1_ref, b1_ref), (sa_ref, sb_ref))
+        ):
+            acc_ref[:, :] = jnp.zeros((2 * h + GROUP, TB), jnp.uint32)
+            _accumulate_prod(a_ref, b_ref, acc_ref, h, TB)
+            out_ref[pl.ds(idx * 2 * h, 2 * h), :] = acc_ref[0 : 2 * h, :]
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _prod3_call(h: int, B: int, TB: int, interpret: bool):
+    kernel = _make_prod3_kernel(h, TB)
+    spec = pl.BlockSpec((h, TB), lambda i: (0, i), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // TB,),
+        in_specs=[spec] * 6,
+        out_specs=pl.BlockSpec(
+            (6 * h, TB), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((6 * h, B), jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((2 * h + GROUP, TB), jnp.uint32)],
         interpret=interpret,
     )
 
@@ -163,27 +206,42 @@ def prod_lm_k1(a, b, TB: int = PROD_TB, interpret: bool | None = None):
 
     Returns the same (2L, B) redundant accumulator shape as prod_lm; only
     the digit decomposition differs, which _redc's carry normalization
-    absorbs. Requires L even (all supported key sizes; falls back to
-    prod_lm otherwise).
+    absorbs. Requires L even with L/2 a multiple of GROUP (all supported
+    key sizes; falls back to prod_lm otherwise).
 
     MEASURED VERDICT (v5e, sustained fold): the 25% multiply saving does
-    not survive the extra dispatches + combine passes — 3.6% SLOWER at
-    L=256 (16.9 vs 16.3 ms @ K=32768) and only 2.5% faster at L=512
-    (14.0 vs 14.3 ms @ K=8192). Kept flag-gated (DDS_KARATSUBA=1) as a
+    not survive the XLA-side combine — ~4% SLOWER at L=256 (17.0 vs
+    16.4 ms @ K=32768) and only ~3.5% faster at L=512 (14.0 vs 14.5 ms
+    @ K=8192), and fusing all three half-products into ONE dispatch
+    (_prod3_call, used here) moved those numbers by <1% vs the composed
+    three-dispatch form — so the cost is the combine's HBM passes
+    (2 carry_norms + complement adds + assembly over (2h..2L, B) arrays),
+    not dispatch overhead. Kept flag-gated (DDS_KARATSUBA=1) as a
     correctness-tested experiment and as the record of why the default
-    stays plain schoolbook; a win here needs in-kernel Karatsuba (one
-    dispatch), not composition."""
+    stays plain schoolbook; a genuine win needs the combine in VMEM too
+    (full in-kernel Karatsuba with in-kernel carries)."""
+    if interpret is None:
+        interpret = _interpret_default()
     L = a.shape[0]
-    if L % 2:
+    if L % 2 or (L // 2) % GROUP:
         return prod_lm(a, b, TB, interpret)
     h = L // 2
     a0, a1 = a[:h], a[h:]
     b0, b1 = b[:h], b[h:]
-    z0 = prod_lm(a0, b0, TB, interpret)                    # (2h, B)
-    z2 = prod_lm(a1, b1, TB, interpret)                    # (2h, B)
     sa, ca = carry_norm(a0 + a1)                           # (h,B), (1,B) in {0,1}
     sb, cb = carry_norm(b0 + b1)
-    z1 = prod_lm(sa, sb, TB, interpret)                    # (2h, B)
+    ap0, B0 = _pad_lanes(a0, TB)
+    bp0, _ = _pad_lanes(b0, TB)
+    ap1, _ = _pad_lanes(a1, TB)
+    bp1, _ = _pad_lanes(b1, TB)
+    sap, _ = _pad_lanes(sa, TB)
+    sbp, _ = _pad_lanes(sb, TB)
+    out = _prod3_call(h, ap0.shape[1], TB, interpret)(
+        ap0, bp0, ap1, bp1, sap, sbp
+    )
+    z0 = out[0 : 2 * h, :B0]                               # (2h, B)
+    z2 = out[2 * h : 4 * h, :B0]
+    z1 = out[4 * h :, :B0]
     rows = 2 * h + 1
     B = a.shape[1]
     # z1_full = (sa + ca*X)(sb + cb*X) over `rows` digits: cross terms are
