@@ -129,7 +129,7 @@ def test_element_routes():
     asyncio.run(go())
 
 
-@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+@pytest.mark.parametrize("backend", ["cpu", "tpu", "native"])
 def test_sum_and_sumall_paillier(backend):
     async def go():
         async with rest_stack(crypto_backend=backend) as (server, _, _):
@@ -171,7 +171,7 @@ def test_sum_and_sumall_paillier(backend):
     asyncio.run(go())
 
 
-@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+@pytest.mark.parametrize("backend", ["cpu", "tpu", "native"])
 def test_mult_and_multall_rsa(backend):
     async def go():
         async with rest_stack(crypto_backend=backend) as (server, _, _):

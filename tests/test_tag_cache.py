@@ -640,3 +640,57 @@ def test_search_routes_use_validated_cache():
             assert json.loads(data)["keyset"] == [keys[2], keys[3], keys[1]]
 
     asyncio.run(go())
+
+
+def test_codec_roundtrip_fuzz():
+    """Randomized wire-codec roundtrips: every message type with random
+    field content (incl. protocol-marker-shaped client data inside stored
+    sets) survives dumps/loads exactly."""
+    import random
+
+    rng = random.Random(99)
+
+    def rand_value():
+        pool = [
+            rng.getrandbits(64),
+            str(rng.getrandbits(128)),
+            None,
+            True,
+            {"__msg__": "nope"},
+            {"__tag__": [1, "x"]},
+            {"__b64__": "AA=="},
+            [rng.getrandbits(16), "s", None],
+        ]
+        return rng.choice(pool)
+
+    def rand_set():
+        return [rand_value() for _ in range(rng.randrange(0, 5))]
+
+    def rand_tag():
+        return M.ABDTag(rng.getrandbits(32), f"replica-{rng.randrange(9)}")
+
+    for _ in range(200):
+        sig = bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 33)))
+        nonce = rng.getrandbits(63)
+        key = str(rng.getrandbits(256))
+        msgs = [
+            M.Envelope(M.IWrite(key, rand_set()), nonce, sig),
+            M.Envelope(M.IRead(key), nonce, sig),
+            M.Envelope(M.IReadReply(key, rand_set(), tag=rand_tag()), nonce, sig),
+            M.TagReply(rand_tag(), key, rand_set(), sig, nonce),
+            M.Write(rand_tag(), key, rand_set(), sig, nonce),
+            M.ReadReply(rand_tag(), key, rand_set(), sig, nonce),
+            M.ReadTagBatch(tuple(str(rng.getrandbits(64)) for _ in range(3)),
+                           nonce, sig, bytes(32) if rng.random() < 0.5 else None),
+            M.TagBatchReply(tuple(rand_tag() for _ in range(3)), key, sig,
+                            nonce, unchanged=rng.random() < 0.5,
+                            fingerprint=bytes(32)),
+            M.Suspect(f"host:1/{key[:8]}", nonce),
+            M.State({key: {"tag": [1, "r"], "value": rand_set()}}, [nonce]),
+            M.Sleep({key: {"tag": [2, "r"], "value": None}}, [nonce, nonce + 1]),
+            M.ActiveReplicas([f"h:{i}/r-{i}" for i in range(3)]),
+            M.Redeploy(f"h:1/{key[:6]}"),
+            M.Redeployed(f"h:1/{key[:6]}"),
+        ]
+        m = msgs[rng.randrange(len(msgs))]
+        assert M.loads(M.dumps(m)) == m
