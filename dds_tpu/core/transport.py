@@ -117,8 +117,16 @@ class TcpNet(Transport):
         frame_secret: bytes | None = None,
         node_key=None,
         peer_keys: dict | None = None,
+        advertise: str = "",
     ):
         self.host, self.port = host, port
+        # The address peers use to reach/name this process. A process that
+        # binds 0.0.0.0 (or binds an IP while peers address it by hostname)
+        # must advertise the peer-visible address, or every signed inbound
+        # frame fails the dest-host check below and the fabric silently
+        # drops all traffic. "host" or "host:port"; empty = the bind
+        # address.
+        self._advertise = advertise
         self._handlers: dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: dict[str, asyncio.StreamWriter] = {}
@@ -172,8 +180,17 @@ class TcpNet(Transport):
         host, port = hostport.rsplit(":", 1)
         return host, int(port), name
 
+    @property
+    def advertised(self) -> str:
+        """This process's peer-visible "host:port" (see `advertise`)."""
+        if self._advertise:
+            if ":" in self._advertise:
+                return self._advertise
+            return f"{self._advertise}:{self.port}"
+        return f"{self.host}:{self.port}"
+
     def local_addr(self, name: str) -> str:
-        return f"{self.host}:{self.port}/{name}"
+        return f"{self.advertised}/{name}"
 
     def register(self, addr: str, handler: Handler) -> None:
         _, _, name = self.split(addr) if "/" in addr else (None, None, addr)
@@ -226,8 +243,22 @@ class TcpNet(Transport):
                 frame = await reader.readexactly(size)
                 import json
 
-                obj = json.loads(frame)
-                src, dest, payload = obj["src"], obj["dest"], obj["msg"]
+                # Per-frame decode must not tear down the shared connection:
+                # a malformed frame (or one from a peer speaking a newer
+                # codec during a rolling upgrade) is logged and skipped —
+                # killing the loop here would silently drop every queued
+                # frame behind it from the same peer.
+                try:
+                    obj = json.loads(frame)
+                    src, dest, payload = obj["src"], obj["dest"], obj["msg"]
+                    if not isinstance(src, str) or not isinstance(dest, str):
+                        raise ValueError("non-string src/dest")
+                except Exception as e:
+                    log.warning(
+                        "dropping undecodable frame from %s: %s",
+                        writer.get_extra_info("peername"), e,
+                    )
+                    continue
                 body = None
                 if self._frame_secret is not None or self._peer_keys is not None:
                     body = self._frame_body(src, dest, payload, obj.get("ctr"))
@@ -245,13 +276,12 @@ class TcpNet(Transport):
                     try:
                         if pub is None:
                             raise ValueError("unregistered src host")
-                        # the signed dest must name THIS process: endpoint
-                        # names repeat across hosts (proxy-0, nodehost), so
-                        # a frame captured on the wire to host A must not
-                        # verify and dispatch on host B
-                        if "/" in dest and dest.split("/", 1)[0] != (
-                            f"{self.host}:{self.port}"
-                        ):
+                        # the signed dest must name THIS process (by its
+                        # ADVERTISED address): endpoint names repeat across
+                        # hosts (proxy-0, nodehost), so a frame captured on
+                        # the wire to host A must not verify and dispatch
+                        # on host B
+                        if "/" in dest and dest.split("/", 1)[0] != self.advertised:
                             raise ValueError("frame destined for another host")
                         pub.verify(bytes.fromhex(obj.get("sig", "")), body)
                         ctr = int(obj["ctr"])
@@ -268,7 +298,15 @@ class TcpNet(Transport):
                 name = dest.split("/", 1)[1] if "/" in dest else dest
                 handler = self._handlers.get(name)
                 if handler is not None:
-                    asyncio.ensure_future(handler(src, M.from_dict(payload)))
+                    try:
+                        msg = M.from_dict(payload)
+                    except Exception as e:
+                        log.warning(
+                            "dropping frame with undecodable payload from "
+                            "%s: %s", src, e,
+                        )
+                        continue
+                    asyncio.ensure_future(handler(src, msg))
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:

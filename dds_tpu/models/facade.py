@@ -33,8 +33,11 @@ class HomoProvider:
     fast_blinding: bool = True
 
     @staticmethod
-    def generate(paillier_bits: int = 2048, rsa_bits: int = 1024) -> "HomoProvider":
-        return HomoProvider(HEKeys.generate(paillier_bits, rsa_bits))
+    def generate(paillier_bits: int = 2048, rsa_bits: int = 1024,
+                 fast_blinding: bool = True) -> "HomoProvider":
+        return HomoProvider(
+            HEKeys.generate(paillier_bits, rsa_bits), fast_blinding=fast_blinding
+        )
 
     def encrypt(self, value, tag: str):
         k = self.keys

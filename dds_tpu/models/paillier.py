@@ -63,15 +63,34 @@ class PaillierPublicKey:
         """A fresh obfuscator r^n mod n^2 for `encrypt(..., rn=...)`."""
         return powmod(self.random_r(), self.n, self.nsquare)
 
-    def blind_fast(self, s_bits: int = 448) -> int:
+    def _djn_s_bits(self) -> int:
+        """Short-exponent width scaled to the modulus's NIST strength
+        estimate (1024->80, 2048->112, 3072->128, 4096->152, 7680->192,
+        15360->256 bits): s_bits = 4x strength, floor 320 — 448 at the
+        2048-bit default, growing with the key instead of staying fixed."""
+        bits = self.n.bit_length()
+        for thresh, strength in (
+            (15360, 256), (7680, 192), (4096, 152), (3072, 128),
+            (2048, 112), (0, 80),
+        ):
+            if bits >= thresh:
+                return max(320, 4 * strength)
+        raise AssertionError("unreachable")
+
+    def blind_fast(self, s_bits: int | None = None) -> int:
         """Fresh obfuscator via the Damgard-Jurik-Nielsen short-exponent
         trick: precompute B0 = r0^n mod n^2 once per key, then each
         obfuscator is B0^s for a random `s_bits`-wide s — i.e. (r0^s)^n,
         a valid r^n with r = r0^s. Encryption cost drops from one n-width
         modexp to one s-width modexp (~5x at 2048 bits). Indistinguish-
         ability rests on the standard DJN subgroup argument with
-        s_bits >= 2x the security level (448 > 2*112 for 2048-bit n);
-        callers wanting the textbook scheme use blind()/encrypt(r=...)."""
+        s_bits >= 2x the security level (default scales with the modulus,
+        _djn_s_bits: 448 = 4*112 for 2048-bit n); callers wanting the
+        textbook scheme use blind()/encrypt(r=...) — or the
+        `client.fast-blinding = false` config knob, which turns this path
+        off for the whole client."""
+        if s_bits is None:
+            s_bits = self._djn_s_bits()
         b0 = _B0_CACHE.get(self.n)
         if b0 is None:
             b0 = powmod(self.random_r(), self.n, self.nsquare)

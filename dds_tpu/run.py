@@ -111,6 +111,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             frame_secret=cfg.security.transport_frame_secret.encode() or None,
             node_key=node_key,
             peer_keys=peer_keys,
+            advertise=cfg.transport.advertise,
         )
         await net.start()
         cfg.transport.port = net.port  # resolve OS-assigned port 0
@@ -118,8 +119,19 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
         # Every endpoint must be a routable `host:port/name` address
         # (`TcpNet.split`): names map through `replicas.addresses`, the
         # per-host topology of `dds-system.conf:113-128`; unmapped names
-        # live in this process.
-        local_hostport = f"{net.host}:{net.port}"
+        # live in this process. Always the ADVERTISED address — frames this
+        # process signs carry it as src, and peers verify src against their
+        # node_public_keys registry.
+        local_hostport = net.advertised
+        if peer_keys is not None and local_hostport not in cfg.security.node_public_keys:
+            await net.stop()  # fail-fast must not leak the bound listener
+            raise ValueError(
+                f"per-node identity is on but this process's advertised "
+                f"address {local_hostport!r} is not in "
+                f"security.node_public_keys — peers could never verify its "
+                f"frames (set transport.advertise to the registered address, "
+                f"or register this one)"
+            )
 
         def full(name: str) -> str:
             return f"{cfg.replicas.addresses.get(name, local_hostport)}/{name}"
@@ -338,18 +350,17 @@ def load_provider(cfg: DDSConfig) -> HomoProvider:
 
     c = cfg.client
     if c.he_keys_inline:
-        return HomoProvider(HEKeys.from_json(c.he_keys_inline))
-    if c.he_keys_path:
-        p = pathlib.Path(c.he_keys_path)
-        if p.exists():
-            return HomoProvider(HEKeys.from_json(p.read_text()))
+        keys = HEKeys.from_json(c.he_keys_inline)
+    elif c.he_keys_path and pathlib.Path(c.he_keys_path).exists():
+        keys = HEKeys.from_json(pathlib.Path(c.he_keys_path).read_text())
+    else:
         keys = HEKeys.generate(c.paillier_bits, c.rsa_bits)
-        from dds_tpu.utils.nodeauth import write_secret_file
+        if c.he_keys_path:
+            from dds_tpu.utils.nodeauth import write_secret_file
 
-        # born 0600: these private keys decrypt the whole store
-        write_secret_file(p, keys.to_json())
-        return HomoProvider(keys)
-    return HomoProvider.generate(c.paillier_bits, c.rsa_bits)
+            # born 0600: these private keys decrypt the whole store
+            write_secret_file(pathlib.Path(c.he_keys_path), keys.to_json())
+    return HomoProvider(keys, fast_blinding=c.fast_blinding)
 
 
 async def run_workload(dep: Deployment, provider: HomoProvider | None = None,

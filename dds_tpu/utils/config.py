@@ -104,6 +104,13 @@ class TransportConfig:
     kind: str = "memory"               # memory | tcp
     host: str = "127.0.0.1"
     port: int = 2552
+    # Peer-visible address of this process ("host" or "host:port"); set it
+    # whenever the bind address differs from how peers name this process
+    # (0.0.0.0 binds, NAT, hostname-vs-IP). Empty = bind address. With
+    # per-node identity enabled, launch() fails fast if the advertised
+    # address is missing from security.node_public_keys — peers could
+    # never verify this process's frames.
+    advertise: str = ""
 
 
 @dataclass
@@ -137,6 +144,10 @@ class ClientSettings:
     #   (wins over the path when set).
     he_keys_path: str = ""
     he_keys_inline: str = ""
+    # PSSE encryption obfuscators: True = DJN short-exponent blinding
+    # (models/paillier.py blind_fast — ~5x cheaper per ciphertext, rests on
+    # the DJN subgroup assumption), False = textbook full-width r^n.
+    fast_blinding: bool = True
 
 
 @dataclass

@@ -199,3 +199,143 @@ def test_launch_with_tls_and_snapshots(tmp_path):
             await dep2.stop()
 
     asyncio.run(go())
+
+
+# ------------------------------------------- config + transport hardening
+
+
+def test_default_toml_parses_and_is_production_safe():
+    """The shipped catalog config must be deployment-safe: fault injection
+    OFF by default (replicas then ignore Trudy's Crash/Compromise control
+    messages — the dataclass default, which the catalog previously
+    overrode to True)."""
+    import pathlib
+
+    from dds_tpu.utils.config import DDSConfig
+
+    cfg = DDSConfig.load(
+        pathlib.Path(__file__).resolve().parent.parent / "configs/default.toml"
+    )
+    assert cfg.attacks.enabled is False
+    assert cfg.client.fast_blinding is True
+    assert cfg.transport.advertise == ""
+
+
+def test_tcpnet_advertised_address():
+    from dds_tpu.core.transport import TcpNet
+
+    net = TcpNet("0.0.0.0", 2552)
+    assert net.advertised == "0.0.0.0:2552"
+    assert TcpNet("0.0.0.0", 2552, advertise="10.0.0.9").advertised == "10.0.0.9:2552"
+    assert (
+        TcpNet("0.0.0.0", 2552, advertise="10.0.0.9:9999").advertised
+        == "10.0.0.9:9999"
+    )
+    assert (
+        TcpNet("0.0.0.0", 2552, advertise="edge.example:2552").local_addr("r-0")
+        == "edge.example:2552/r-0"
+    )
+
+
+def test_launch_rejects_unregistered_advertised_address(tmp_path):
+    """With per-node identity on, a process whose advertised address is not
+    in node_public_keys would emit frames no peer can verify (and, bound to
+    0.0.0.0, would itself reject every signed inbound frame) — launch()
+    must fail fast instead of deploying a silently deaf fabric."""
+    from dds_tpu.run import launch
+    from dds_tpu.utils import nodeauth
+    from dds_tpu.utils.config import DDSConfig
+
+    async def go():
+        key = nodeauth.generate()
+        cfg = DDSConfig()
+        cfg.transport.kind = "tcp"
+        cfg.transport.port = 0
+        cfg.transport.host = "127.0.0.1"
+        cfg.recovery.enabled = False
+        cfg.proxy.port = 0
+        cfg.security.node_key_path = str(tmp_path / "node.key")
+        # registry names an address this process does NOT advertise
+        cfg.security.node_public_keys = {
+            "10.9.9.9:2552": nodeauth.public_hex(key)
+        }
+        with pytest.raises(ValueError, match="advertised"):
+            await launch(cfg)
+
+    asyncio.run(go())
+
+
+def test_undecodable_frame_does_not_kill_connection():
+    """A malformed frame (bad JSON, unknown message type) must be dropped
+    per-frame — not tear down the shared cached connection and lose every
+    queued frame behind it (rolling-upgrade safety)."""
+    from dds_tpu.core import messages as M
+    from dds_tpu.core.transport import TcpNet
+
+    async def go():
+        net = TcpNet("127.0.0.1", 0)
+        await net.start()
+        got = []
+
+        async def handler(src, msg):
+            got.append(msg)
+
+        net.register("sink", handler)
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", net.port)
+
+            def frame(raw: bytes) -> bytes:
+                return len(raw).to_bytes(4, "big") + raw
+
+            good = json.dumps(
+                {
+                    "src": "peer",
+                    "dest": "sink",
+                    "msg": M.to_dict(M.Redeploy("replica-0")),
+                }
+            ).encode()
+            writer.write(frame(b"this is not json"))
+            writer.write(frame(json.dumps({"src": "p"}).encode()))  # missing keys
+            writer.write(  # type-confused fields must not escape the guard
+                frame(json.dumps({"src": "p", "dest": 123, "msg": {}}).encode())
+            )
+            writer.write(frame(json.dumps(["a", "list"]).encode()))
+            writer.write(
+                frame(
+                    json.dumps(
+                        {"src": "p", "dest": "sink", "msg": {"__msg__": "Nope"}}
+                    ).encode()
+                )
+            )
+            writer.write(frame(good))  # must still arrive on the SAME conn
+            await writer.drain()
+            for _ in range(100):
+                if got:
+                    break
+                await asyncio.sleep(0.02)
+            assert got and isinstance(got[0], M.Redeploy)
+            writer.close()
+        finally:
+            await net.stop()
+
+    asyncio.run(go())
+
+
+def test_fast_blinding_knob_and_scaled_s_bits():
+    from dds_tpu.models.paillier import PaillierPublicKey
+    from dds_tpu.run import load_provider
+    from dds_tpu.utils.config import DDSConfig
+
+    cfg = DDSConfig()
+    cfg.client.paillier_bits = 1024
+    cfg.client.rsa_bits = 1024
+    cfg.client.fast_blinding = False
+    assert load_provider(cfg).fast_blinding is False
+    cfg.client.fast_blinding = True
+    assert load_provider(cfg).fast_blinding is True
+
+    # s_bits scales with the modulus strength instead of a fixed 448
+    assert PaillierPublicKey(1 << 2047)._djn_s_bits() == 448
+    assert PaillierPublicKey(1 << 3071)._djn_s_bits() == 512
+    assert PaillierPublicKey(1 << 4095)._djn_s_bits() == 608
+    assert PaillierPublicKey(1 << 1023)._djn_s_bits() == 320
