@@ -152,6 +152,13 @@ class TpuBackend:
         # one multiply: a device round-trip can never win
         return c1 * c2 % modulus
 
+    def _mesh_kernel(self) -> str:
+        """Kernel family for the shard-local math under a mesh: the SAME
+        one the single-chip path would use (v1/v2 when pallas is on, the
+        portable jnp scans otherwise) — N chips must mean N x the fast
+        kernel, not N x the portable one (parallel/mesh.py docstring)."""
+        return self.kernel if self.pallas else "jnp"
+
     def _get_mesh(self):
         if self.mesh is None and self._mesh_n > 1:
             from dds_tpu.parallel.mesh import make_mesh
@@ -169,7 +176,9 @@ class TpuBackend:
         if mesh is not None and mesh.devices.size > 1:
             from dds_tpu.parallel import mesh as pm
 
-            return pm.sharded_reduce_mul_fixed(ctx, batch, mesh)
+            return pm.sharded_reduce_mul_fixed(
+                ctx, batch, mesh, kernel=self._mesh_kernel()
+            )
         if self.pallas:
             if self.kernel == "v2":
                 from dds_tpu.ops import mont_mxu
@@ -205,7 +214,9 @@ class TpuBackend:
                 one = np.zeros((padded - B, ctx.L), np.uint32)
                 one[:, 0] = 1
                 batch = jnp.concatenate([jnp.asarray(batch), jnp.asarray(one)], 0)
-            out = pm.sharded_pow_mod(ctx, batch, _exp_to_digits(exp), mesh)
+            out = pm.sharded_pow_mod(
+                ctx, batch, _exp_to_digits(exp), mesh, kernel=self._mesh_kernel()
+            )
             return bn.batch_to_ints(np.asarray(out)[:B])
         if self.pallas:
             if self.kernel == "v2":

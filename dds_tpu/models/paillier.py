@@ -69,11 +69,15 @@ class PaillierPublicKey:
         15360->256 bits): s_bits = 4x strength, floor 320 — 448 at the
         2048-bit default, growing with the key instead of staying fixed."""
         bits = self.n.bit_length()
+        # 16 bits of slack: imported keys (he-keys-inline/path) may come
+        # from generators that don't force the top bits of p*q, giving a
+        # nominally-2048-bit modulus of 2047 bits — that must not silently
+        # drop a full strength tier
         for thresh, strength in (
             (15360, 256), (7680, 192), (4096, 152), (3072, 128),
             (2048, 112), (0, 80),
         ):
-            if bits >= thresh:
+            if bits >= thresh - 16:
                 return max(320, 4 * strength)
         raise AssertionError("unreachable")
 

@@ -473,8 +473,10 @@ def mul2_lm(mctx: MxuCtx, a, b, interpret: bool | None = None,
 # ---------------------------------------------------------------------------
 
 
-@functools.lru_cache(maxsize=None)
-def _pow2_fn(mctx: MxuCtx, E: int, interpret: bool, karatsuba: bool):
+def _pow2_body(mctx: MxuCtx, E: int, interpret: bool, karatsuba: bool):
+    """The traced ladder body (un-jitted): callers that already run under a
+    transform (jit in _pow2_fn, shard_map in parallel/mesh.py) close over
+    this directly."""
     ctx = mctx.ctx
     mul = functools.partial(mul2_lm, karatsuba=karatsuba)
 
@@ -509,7 +511,12 @@ def _pow2_fn(mctx: MxuCtx, E: int, interpret: bool, karatsuba: bool):
         )                                                     # from mont
         return out.T
 
-    return jax.jit(run)
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _pow2_fn(mctx: MxuCtx, E: int, interpret: bool, karatsuba: bool):
+    return jax.jit(_pow2_body(mctx, E, interpret, karatsuba))
 
 
 def pow_mod2(mctx: MxuCtx, bases, exp: int, interpret: bool | None = None):

@@ -13,8 +13,16 @@ data-parallel batched ciphertext arithmetic sharded over a device mesh
   is not an add, so `psum` does not apply) and a replicated log2(D) tail
   reduction.
 
+The shard-local math runs the SAME kernel family the single-chip path
+uses (`kernel=`): "v2" = VPU product + MXU band-REDC (ops/mont_mxu),
+"v1" = fused CIOS Pallas (ops/pallas_mont), "jnp" = the portable scan
+kernels — so N chips mean N x the fast kernel, not N x the portable one.
+Only the O(D) combine (D-1 multiplies of one residue each) stays on the
+portable `_mont_mul_raw`: a Pallas dispatch per single-row multiply would
+pad 1 lane to a full tile and cost more than it saves.
+
 Works identically on a real TPU slice and on the test fabric
-(`--xla_force_host_platform_device_count`).
+(`--xla_force_host_platform_device_count`, Pallas in interpret mode).
 """
 
 from __future__ import annotations
@@ -30,6 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dds_tpu.ops import bignum as bn
 from dds_tpu.ops.montgomery import ModCtx, _mont_mul_raw, _mont_exp_raw, _tree_reduce_raw
 
+KERNELS = ("jnp", "v1", "v2")
+
 
 def make_mesh(n_devices: int | None = None, axis: str = "batch") -> Mesh:
     devs = jax.devices()
@@ -38,8 +48,14 @@ def make_mesh(n_devices: int | None = None, axis: str = "batch") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
-# jitted shard_map executables, keyed by (op, modulus, mesh, axis): the
-# serving path calls these per aggregate request, and rebuilding the
+def _check_kernel(kernel: str) -> str:
+    if kernel not in KERNELS:
+        raise ValueError(f"unknown mesh kernel {kernel!r} (have {KERNELS})")
+    return kernel
+
+
+# jitted shard_map executables, keyed by (op, modulus, mesh, axis, kernel):
+# the serving path calls these per aggregate request, and rebuilding the
 # closure each call would defeat jax.jit's trace cache (jit keys on
 # function identity + shapes). Bounded FIFO (like ModCtx.make's lru_cache):
 # on the serving path the modulus comes from the client-supplied `nsqr`
@@ -57,6 +73,49 @@ def _fn_cache_put(key, fn) -> None:
         while len(_FN_CACHE) >= _FN_CACHE_MAX:
             _FN_CACHE.pop(next(iter(_FN_CACHE)), None)
         _FN_CACHE[key] = fn
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _halving_tree_lm(mul_lm, x):
+    """Power-of-two tree fold over the lane axis of limbs-major x (L, W):
+    repeatedly multiply the left half by the right half with `mul_lm`
+    until one lane remains. Shared by both Pallas kernel families here
+    (and the same shape as mont_mxu._reduce2_fn's in-jit tree)."""
+    w = x.shape[1]
+    while w > 1:
+        h = w // 2
+        x = mul_lm(x[:, :h], x[:, h : 2 * h])
+        w = h
+    return x
+
+
+def _local_fold_fn(ctx: ModCtx, kernel: str, interpret: bool):
+    """Shard-local tree fold: (P2, L) batch-major -> (1, L) partial product
+    (times R^-(P2-1)), on the configured kernel family."""
+    if kernel == "v2":
+        from dds_tpu.ops import mont_mxu
+
+        mctx = mont_mxu.MxuCtx.make(ctx)
+        karatsuba = mont_mxu._use_karatsuba()
+        mul = lambda a, b: mont_mxu.mul2_lm(mctx, a, b, interpret, karatsuba)
+        return lambda local: _halving_tree_lm(mul, local.T).T
+    if kernel == "v1":
+        from dds_tpu.ops import pallas_mont
+
+        mul = lambda a, b: pallas_mont.mul_lm(ctx, a, b, interpret=interpret)
+        return lambda local: _halving_tree_lm(mul, local.T).T
+
+    N = jnp.asarray(ctx.N)
+    n0inv = jnp.uint32(ctx.n0inv)
+    one_mont = jnp.asarray(ctx.one_mont)
+
+    def fold(local):
+        return _tree_reduce_local(local, N, n0inv, one_mont)
+
+    return fold
 
 
 def _tree_reduce_local(cs, N, n0inv, one_mont):
@@ -77,13 +136,14 @@ def _tree_reduce_local(cs, N, n0inv, one_mont):
 
 
 def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch",
-                       ring: bool = False):
+                       ring: bool = False, kernel: str = "jnp"):
     """Modular product of K ciphertexts sharded over `mesh`.
 
     cs: (K, L) plain-domain, K divisible by mesh size times 1 (padded here
     to a power of two per shard with the Montgomery identity, like
     ModCtx.reduce_mul). Returns (1, L) = prod(cs) * R^-(K-1) mod n,
     replicated; callers fix the R power exactly as ModCtx.reduce_mul does.
+    `kernel` picks the shard-local fold family (module docstring).
 
     Two combine collectives, same result and R accounting (D partials,
     D-1 montgomery multiplies either way):
@@ -94,6 +154,7 @@ def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch",
       that wins when per-device payloads are large enough that an
       all_gather would burst-buffer D copies at once.
     """
+    _check_kernel(kernel)
     D = mesh.devices.size
     K = cs.shape[0]
     shard = -(-K // D)
@@ -103,17 +164,21 @@ def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch",
         pad = jnp.broadcast_to(jnp.asarray(ctx.one_mont), (total - K, ctx.L))
         cs = jnp.concatenate([jnp.asarray(cs), pad], axis=0)
 
-    key = ("reduce", ctx.n, mesh, axis, ring)
+    # NOT keyed on P2: jit retraces per input shape under one cache entry,
+    # and nothing in the closure bakes the shard width — keying on it would
+    # fragment the bounded FIFO per request size and churn compiles
+    key = ("reduce", ctx.n, mesh, axis, ring, kernel)
     fn = _FN_CACHE.get(key)
     if fn is None:
         N = jnp.asarray(ctx.N)
         n0inv = jnp.uint32(ctx.n0inv)
         one_mont = jnp.asarray(ctx.one_mont)
         perm = [(d, (d + 1) % D) for d in range(D)]
+        local_fold = _local_fold_fn(ctx, kernel, _interpret_default())
 
         def step(local):
             # local: (P2, L) on each device
-            partial = _tree_reduce_local(local, N, n0inv, one_mont)   # (1, L)
+            partial = local_fold(local)                           # (1, L)
             if ring:
                 def hop(_, acc_msg):
                     acc, msg = acc_msg
@@ -141,39 +206,78 @@ def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch",
 
 
 def sharded_reduce_mul_fixed(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch",
-                             ring: bool = False):
+                             ring: bool = False, kernel: str = "jnp"):
     """Like ModCtx.reduce_mul but mesh-sharded: returns prod(cs) mod n (1, L)."""
     K = cs.shape[0]
-    prod = sharded_reduce_mul(ctx, cs, mesh, axis, ring)
+    prod = sharded_reduce_mul(ctx, cs, mesh, axis, ring, kernel)
     R = 1 << (bn.LIMB_BITS * ctx.L)
     fix = bn.int_to_limbs(pow(R % ctx.n, K, ctx.n), ctx.L)
     return ctx.mont_mul(prod, jnp.asarray(fix)[None, :])
 
 
-def sharded_pow_mod(ctx: ModCtx, bases, exp_digits, mesh: Mesh, axis: str = "batch"):
+def sharded_pow_mod(ctx: ModCtx, bases, exp_digits, mesh: Mesh,
+                    axis: str = "batch", kernel: str = "jnp"):
     """Batched modexp with the batch axis sharded across the mesh.
 
     bases: (B, L) plain domain, B divisible by mesh size. exp_digits:
     (E,) uint32 4-bit MSB-first digits, replicated. Purely data-parallel —
-    zero collectives; each device exponentiates its shard.
+    zero collectives; each device exponentiates its shard on the
+    configured kernel family.
     """
-    key = ("pow", ctx.n, mesh, axis)
+    _check_kernel(kernel)
+    E = int(exp_digits.shape[0])
+    # E is in the key only for v2: _pow2_body bakes `E > 1` into the trace;
+    # the jnp/v1 steps derive everything from the digits' runtime shape, so
+    # one entry per modulus serves every exponent width there
+    key = ("pow", ctx.n, mesh, axis, kernel, E if kernel == "v2" else None)
     fn = _FN_CACHE.get(key)
     if fn is None:
-        N = jnp.asarray(ctx.N)
-        n0inv = jnp.uint32(ctx.n0inv)
-        R2 = jnp.asarray(ctx.R2)
-        one_mont = jnp.asarray(ctx.one_mont)
-        one_plain = np.zeros((ctx.L,), np.uint32)
-        one_plain[0] = 1
-        one_plain = jnp.asarray(one_plain)
+        interpret = _interpret_default()
+        if kernel == "v2":
+            from dds_tpu.ops import mont_mxu
 
-        def step(local_bases, digits):
-            mont = _mont_mul_raw(
-                local_bases, jnp.broadcast_to(R2, local_bases.shape), N, n0inv
+            mctx = mont_mxu.MxuCtx.make(ctx)
+            body = mont_mxu._pow2_body(
+                mctx, E, interpret, mont_mxu._use_karatsuba()
             )
-            r = _mont_exp_raw(mont, digits, one_mont, N, n0inv)
-            return _mont_mul_raw(r, jnp.broadcast_to(one_plain, r.shape), N, n0inv)
+
+            def step(local_bases, digits):
+                return body(local_bases, digits.astype(jnp.int32))
+        elif kernel == "v1":
+            from dds_tpu.ops import pallas_mont
+
+            R2col = jnp.asarray(ctx.R2)[:, None]
+            one = np.zeros((ctx.L, 1), np.uint32)
+            one[0, 0] = 1
+            one = jnp.asarray(one)
+
+            def step(local_bases, digits):
+                x = local_bases.T                              # (L, B)
+                xm = pallas_mont.mul_lm(
+                    ctx, x, jnp.broadcast_to(R2col, x.shape), interpret=interpret
+                )
+                r = pallas_mont.exp_lm(
+                    ctx, xm, digits.astype(jnp.int32), interpret=interpret
+                )
+                out = pallas_mont.mul_lm(
+                    ctx, r, jnp.broadcast_to(one, r.shape), interpret=interpret
+                )
+                return out.T
+        else:
+            N = jnp.asarray(ctx.N)
+            n0inv = jnp.uint32(ctx.n0inv)
+            R2 = jnp.asarray(ctx.R2)
+            one_mont = jnp.asarray(ctx.one_mont)
+            one_plain = np.zeros((ctx.L,), np.uint32)
+            one_plain[0] = 1
+            one_plain = jnp.asarray(one_plain)
+
+            def step(local_bases, digits):
+                mont = _mont_mul_raw(
+                    local_bases, jnp.broadcast_to(R2, local_bases.shape), N, n0inv
+                )
+                r = _mont_exp_raw(mont, digits, one_mont, N, n0inv)
+                return _mont_mul_raw(r, jnp.broadcast_to(one_plain, r.shape), N, n0inv)
 
         fn = jax.jit(
             jax.shard_map(

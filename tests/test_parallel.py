@@ -86,6 +86,89 @@ def test_sharded_reduce_non_power_of_two_mesh(D, K):
     assert bn.limbs_to_int(np.asarray(out)[0]) == want
 
 
+# ------------------------------------------- fast kernels under the mesh
+
+@pytest.mark.parametrize("kernel", ["v1", "v2"])
+def test_sharded_reduce_runs_fast_kernels(kernel):
+    """The shard-local fold must run the v1/v2 Pallas kernels (interpret
+    mode on the CPU fabric) and still match python ints — the multi-chip
+    path keeps single-chip kernel speed (VERDICT r4 #1)."""
+    n = rng.getrandbits(512) | (1 << 511) | 1
+    ctx = ModCtx.make(n)
+    mesh = make_mesh(8)
+    cs_int = [rng.randrange(n) for _ in range(21)]
+    cs = bn.ints_to_batch(cs_int, ctx.L)
+    out = sharded_reduce_mul_fixed(ctx, cs, mesh, kernel=kernel)
+    want = 1
+    for c in cs_int:
+        want = want * c % n
+    assert bn.limbs_to_int(np.asarray(out)[0]) == want
+
+
+@pytest.mark.parametrize("kernel", ["v1", "v2"])
+def test_sharded_pow_runs_fast_kernels(kernel):
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    ctx = ModCtx.make(n)
+    mesh = make_mesh(8)
+    exp = rng.getrandbits(48)
+    bases_int = [rng.randrange(n) for _ in range(16)]
+    bases = bn.ints_to_batch(bases_int, ctx.L)
+    out = sharded_pow_mod(ctx, bases, _exp_to_digits(exp), mesh, kernel=kernel)
+    assert bn.batch_to_ints(np.asarray(out)) == [pow(b, exp, n) for b in bases_int]
+
+
+def test_sharded_ring_with_v2_kernel():
+    """ppermute ring combine composes with the v2 shard-local fold."""
+    n = rng.getrandbits(512) | (1 << 511) | 1
+    ctx = ModCtx.make(n)
+    mesh = make_mesh(8)
+    cs_int = [rng.randrange(n) for _ in range(16)]
+    out = sharded_reduce_mul_fixed(
+        ctx, bn.ints_to_batch(cs_int, ctx.L), mesh, ring=True, kernel="v2"
+    )
+    want = 1
+    for c in cs_int:
+        want = want * c % n
+    assert bn.limbs_to_int(np.asarray(out)[0]) == want
+
+
+def test_backend_mesh_dispatches_configured_kernel(monkeypatch):
+    """TpuBackend(pallas=True, kernel=v2, mesh=...) must hand kernel='v2'
+    to the sharded fold/modexp — the wiring the r4 verdict found missing."""
+    from dds_tpu.models.backend import TpuBackend
+    from dds_tpu.parallel import mesh as pm
+
+    seen = []
+    orig_reduce, orig_pow = pm.sharded_reduce_mul_fixed, pm.sharded_pow_mod
+
+    def spy_reduce(*a, **k):
+        seen.append(("reduce", k.get("kernel", "jnp")))
+        return orig_reduce(*a, **k)
+
+    def spy_pow(*a, **k):
+        seen.append(("pow", k.get("kernel", "jnp")))
+        return orig_pow(*a, **k)
+
+    monkeypatch.setattr(pm, "sharded_reduce_mul_fixed", spy_reduce)
+    monkeypatch.setattr(pm, "sharded_pow_mod", spy_pow)
+
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    be = TpuBackend(pallas=True, kernel="v2", min_device_batch=0,
+                    mesh=make_mesh(4))
+    cs = [rng.randrange(n) for _ in range(8)]
+    want = 1
+    for c in cs:
+        want = want * c % n
+    assert be.modmul_fold(cs, n) == want
+    bases = [rng.randrange(n) for _ in range(4)]
+    assert be.powmod_batch(bases, 65537, n) == [pow(b, 65537, n) for b in bases]
+    assert ("reduce", "v2") in seen and ("pow", "v2") in seen
+    # pallas off -> portable jnp kernels under the mesh
+    be_jnp = TpuBackend(pallas=False, min_device_batch=0, mesh=make_mesh(4))
+    assert be_jnp.modmul_fold(cs, n) == want
+    assert seen[-1] == ("reduce", "jnp")
+
+
 # ----------------------------------------------------- serving-path wiring
 
 def test_tpu_backend_folds_through_mesh(monkeypatch):
