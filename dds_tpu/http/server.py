@@ -74,6 +74,19 @@ class ProxyConfig:
     key_sync_warmup: float = 1.0
     key_sync_interval: float = 5.0
     peers: list[str] = field(default_factory=list)  # "host:port"
+    # stored_keys durability. The reference keeps the aggregate key set
+    # in-memory only (`DDSRestServer.scala:70`), so a proxy restart makes
+    # every aggregate silently shrink until re-population — flagged as a
+    # do-not-copy quirk (SURVEY.md §7). Two recovery sources, both opt-in:
+    # - keys_path: JSON snapshot, written atomically (debounced ~200 ms
+    #   after a mutation burst) and loaded at start();
+    # - a one-shot GET /_sync pull from each gossip peer at start() (gated
+    #   with key_sync_enabled), covering proxies deployed without a disk.
+    # The set only names which records aggregates cover — values still come
+    # from the replicated store through full quorum reads, so a stale
+    # snapshot can at worst omit recent keys until gossip catches up, never
+    # serve stale data.
+    keys_path: str = ""
     # GET /_trace observability route. Default OFF: it reveals workload
     # shape (route counts, latencies, store size) to anyone who can reach
     # the client-facing listener — the reference gates observability
@@ -113,13 +126,17 @@ class DDSRestServer:
             self.cfg.host, self.cfg.port, self.handle, self.cfg.ssl_server_context
         )
         self._tasks: list[asyncio.Task] = []
+        self._keys_dirty = False
+        self._keys_saver: asyncio.Task | None = None
 
     # ------------------------------------------------------------ lifecycle
 
     async def start(self) -> None:
+        self._load_keys()
         await self._http.start()
         self.cfg.port = self._http.port  # resolve OS-assigned port 0
         if self.cfg.key_sync_enabled and self.cfg.peers:
+            await self._bootstrap_keys_from_peers()
             self._tasks.append(asyncio.ensure_future(self._key_sync_loop()))
         if self.cfg.supervisor:
             if self.abd.cfg.supervisor is None:
@@ -135,7 +152,104 @@ class DDSRestServer:
             except asyncio.CancelledError:
                 pass
         self._tasks.clear()
+        if self._keys_saver is not None:
+            self._keys_saver.cancel()
+            try:
+                await self._keys_saver
+            except asyncio.CancelledError:
+                pass
+            self._keys_saver = None
+        if self._keys_dirty:
+            self._write_keys_snapshot()  # flush pending mutations on shutdown
         await self._http.stop()
+
+    # ------------------------------------------------- stored_keys recovery
+
+    def _load_keys(self) -> None:
+        if not self.cfg.keys_path:
+            return
+        import json as _json
+        import pathlib
+
+        p = pathlib.Path(self.cfg.keys_path)
+        if not p.exists():
+            return
+        try:
+            keys = _json.loads(p.read_text())
+        except (OSError, ValueError) as e:
+            log.warning("ignoring unreadable stored-keys snapshot %s: %s", p, e)
+            return
+        if not isinstance(keys, list):  # hand-edited / corrupted snapshot
+            log.warning("ignoring malformed stored-keys snapshot %s", p)
+            return
+        for k in keys:
+            if isinstance(k, str):
+                self.stored_keys.add(k)
+        self._stored_version += 1
+        log.info("recovered %d stored keys from %s", len(self.stored_keys), p)
+
+    def _write_keys_snapshot(self) -> None:
+        """Atomic write (tmp + rename): a crash mid-write must leave the
+        previous snapshot intact, not a truncated JSON file."""
+        import json as _json
+        import os
+        import pathlib
+
+        self._keys_dirty = False
+        p = pathlib.Path(self.cfg.keys_path)
+        try:
+            p.parent.mkdir(parents=True, exist_ok=True)
+            tmp = p.with_name(p.name + ".tmp")
+            tmp.write_text(_json.dumps(sorted(self.stored_keys)))
+            os.replace(tmp, p)
+        except OSError as e:
+            log.warning("stored-keys snapshot to %s failed: %s", p, e)
+
+    def _save_keys_soon(self) -> None:
+        """Debounced snapshot: coalesce a PutSet burst into one write."""
+        if not self.cfg.keys_path:
+            return
+        self._keys_dirty = True
+        if self._keys_saver is not None and not self._keys_saver.done():
+            return
+
+        async def _saver():
+            while self._keys_dirty:
+                await asyncio.sleep(0.2)
+                self._write_keys_snapshot()
+
+        self._keys_saver = asyncio.ensure_future(_saver())
+
+    async def _bootstrap_keys_from_peers(self) -> None:
+        """One-shot key pull at start: a restarted proxy must not wait for
+        a peer's next gossip push to see the store's aggregate keys.
+        Pulls run concurrently so N dead peers cost one timeout, not N;
+        any failure is opportunistic-best-effort — it must never turn a
+        recovery optimization into a boot failure."""
+
+        async def pull(peer: str) -> None:
+            host, _, port = peer.partition(":")
+            try:
+                status, body = await http_request(
+                    host, int(port), "GET", "/_sync",
+                    ssl_context=self.cfg.ssl_client_context, timeout=5.0,
+                )
+                if status != 200:
+                    return
+                import json as _json
+
+                before = len(self.stored_keys)
+                for k in J.parse_keys(_json.loads(body)):
+                    self._note_stored(k)
+                log.info(
+                    "bootstrapped %d stored keys from peer %s",
+                    len(self.stored_keys) - before, peer,
+                )
+            except (OSError, ValueError, EOFError, asyncio.TimeoutError) as e:
+                # EOFError covers IncompleteReadError (peer closed mid-body)
+                log.debug("stored-keys bootstrap from %s failed: %s", peer, e)
+
+        await asyncio.gather(*(pull(p) for p in self.cfg.peers))
 
     async def _key_sync_loop(self) -> None:
         await asyncio.sleep(self.cfg.key_sync_warmup)
@@ -190,6 +304,7 @@ class DDSRestServer:
         if key not in self.stored_keys:
             self.stored_keys.add(key)
             self._stored_version += 1
+            self._save_keys_soon()
 
     def _agg_state(self):
         """(state, keys, cached, digest, fingerprint, cached_tags) for the
@@ -455,6 +570,7 @@ class DDSRestServer:
                 if arg in self.stored_keys:
                     self.stored_keys.discard(arg)  # stop aggregating/gossiping
                     self._stored_version += 1
+                    self._save_keys_soon()
                 return Response(200)
 
             case ("PUT", "AddElement") if arg:
@@ -584,6 +700,14 @@ class DDSRestServer:
                 for k in J.parse_keys(req.json()):
                     self._note_stored(k)
                 return Response(204)
+
+            case ("GET", "_sync") if self.cfg.key_sync_enabled:
+                # bootstrap pull: a (re)starting peer fetches the aggregate
+                # key set instead of waiting for the next gossip push.
+                # Gated like the push side: with gossip off this would hand
+                # any client the full record-key set (workload shape) — the
+                # same rationale that keeps /_trace off by default.
+                return Response.json(J.keys_result(sorted(self.stored_keys)))
 
             case ("GET", "_trace") if self.cfg.trace_route_enabled:
                 # live observability (SURVEY §5.5): per-span timing summary

@@ -301,6 +301,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             key_sync_warmup=cfg.proxy.key_sync_warm_up,
             key_sync_interval=cfg.proxy.key_sync_interval,
             peers=cfg.proxy.remote_peers,
+            keys_path=cfg.proxy.stored_keys_path,
             supervisor=sup_addr,
             trace_route_enabled=cfg.debug,
             ssl_server_context=ssl_server,

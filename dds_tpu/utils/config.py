@@ -97,6 +97,10 @@ class ProxySettings:
     key_sync_warm_up: float = 1.0
     key_sync_interval: float = 5.0
     remote_peers: list[str] = field(default_factory=list)
+    # stored_keys snapshot file (empty = in-memory only, the reference's
+    # lossy behavior); restarted proxies also pull keys from remote_peers
+    # at start when key_sync_enabled
+    stored_keys_path: str = ""
 
 
 @dataclass

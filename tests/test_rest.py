@@ -409,3 +409,111 @@ def test_trace_route_reports_span_summary():
             assert spans["http.POST.PutSet"]["mean_ms"] > 0
 
     asyncio.run(go())
+
+
+def test_stored_keys_survive_proxy_restart_via_snapshot(tmp_path):
+    """SURVEY.md §7 do-not-copy quirk: the reference loses the proxy's
+    aggregate key set on restart, silently shrinking every SumAll. With
+    keys_path set, a fresh server object (modeling the restarted process)
+    recovers the keys from the snapshot and folds ALL K sets."""
+
+    async def go():
+        snap = str(tmp_path / "proxy_keys.json")
+        net = InMemoryNet()
+        addrs = [f"replica-{i}" for i in range(7)]
+        replicas = {
+            a: BFTABDNode(a, addrs, "supervisor", net, ReplicaConfig(quorum_size=5))
+            for a in addrs
+        }
+        del replicas  # replicas only need to exist on the net
+        abd = AbdClient("proxy-0", net, addrs, AbdClientConfig(request_timeout=2.0))
+        pk = KEYS.psse.public
+        vals = [rng.randrange(1 << 24) for _ in range(6)]
+
+        s1 = DDSRestServer(abd, ProxyConfig(host="127.0.0.1", port=0, keys_path=snap))
+        await s1.start()
+        try:
+            for v in vals:
+                row = [str(pk.encrypt(v))]
+                status, _ = await call(s1, "POST", "/PutSet", {"contents": row})
+                assert status == 200
+            _, data = await call(s1, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}")
+            assert KEYS.psse.decrypt(int(json.loads(data)["result"])) == sum(vals)
+        finally:
+            await s1.stop()  # flushes the debounced snapshot
+
+        # "restart": brand-new server object, same snapshot path
+        s2 = DDSRestServer(abd, ProxyConfig(host="127.0.0.1", port=0, keys_path=snap))
+        await s2.start()
+        try:
+            assert len(s2.stored_keys) == len(vals)  # recovered, not empty
+            _, data = await call(s2, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}")
+            got = KEYS.psse.decrypt(int(json.loads(data)["result"]))
+            assert got == sum(vals)  # did NOT silently shrink
+        finally:
+            await s2.stop()
+
+    asyncio.run(go())
+
+
+def test_stored_keys_bootstrap_pull_from_peer_on_start():
+    """A proxy restarted WITHOUT a snapshot recovers stored_keys by pulling
+    GET /_sync from its gossip peers at start, instead of waiting for the
+    next periodic push."""
+
+    async def go():
+        net = InMemoryNet()
+        addrs = [f"replica-{i}" for i in range(7)]
+        replicas = {
+            a: BFTABDNode(a, addrs, "supervisor", net, ReplicaConfig(quorum_size=5))
+            for a in addrs
+        }
+        del replicas
+        abd1 = AbdClient("proxy-0", net, addrs, AbdClientConfig(request_timeout=2.0))
+        abd2 = AbdClient("proxy-1", net, addrs, AbdClientConfig(request_timeout=2.0))
+        pk = KEYS.psse.public
+        vals = [3, 5, 11]
+
+        # serving side of the pull is gated on key_sync_enabled too (with
+        # gossip off, GET /_sync would leak the record-key set to clients)
+        s1 = DDSRestServer(
+            abd1,
+            ProxyConfig(host="127.0.0.1", port=0, key_sync_enabled=True,
+                        key_sync_warmup=60.0, key_sync_interval=60.0),
+        )
+        await s1.start()
+        try:
+            for v in vals:
+                await call(s1, "POST", "/PutSet", {"contents": [str(pk.encrypt(v))]})
+            # gossip-off proxies refuse the pull (info leak gate)
+            st, _ = await call(s1, "GET", "/_sync")
+            assert st == 200
+            s_off = DDSRestServer(abd2, ProxyConfig(host="127.0.0.1", port=0))
+            await s_off.start()
+            st, _ = await call(s_off, "GET", "/_sync")
+            assert st == 404
+            await s_off.stop()
+            # restarted peer: no snapshot, pulls from s1 at start (long
+            # gossip interval proves it's the pull, not a push, that fills it)
+            s2 = DDSRestServer(
+                abd2,
+                ProxyConfig(
+                    host="127.0.0.1", port=0, key_sync_enabled=True,
+                    key_sync_warmup=60.0, key_sync_interval=60.0,
+                    peers=[f"127.0.0.1:{s1.cfg.port}"],
+                ),
+            )
+            await s2.start()
+            try:
+                assert len(s2.stored_keys) == len(vals)
+                _, data = await call(
+                    s2, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}"
+                )
+                got = KEYS.psse.decrypt(int(json.loads(data)["result"]))
+                assert got == sum(vals)
+            finally:
+                await s2.stop()
+        finally:
+            await s1.stop()
+
+    asyncio.run(go())
