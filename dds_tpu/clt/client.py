@@ -83,7 +83,31 @@ class DDSHttpClient:
 
     # ------------------------------------------------------------ execution
 
+    def _psse_encrypts_in(self, digest: I.Digest) -> int:
+        """How many PSSE encryptions executing `digest` will perform: one
+        per PutSet row column whose schema slot is PSSE (the bulk of
+        client-side HE cost; reference hot loop SJHomoLibProvider.scala:
+        74-86)."""
+        psse_cols = [
+            i for i, s in enumerate(self.cfg.schema[: self.cfg.fixed_columns])
+            if s == "PSSE"
+        ]
+        count = 0
+        for instr in digest.payload:
+            if isinstance(instr, I.PutSet) and instr.set is not None:
+                count += sum(1 for i in psse_cols if i < len(instr.set))
+        return count
+
     async def execute(self, digest: I.Digest) -> RunReport:
+        # bulk-encryption pre-pass: with a provider bulk backend configured,
+        # batched device modexps precompute every full-width obfuscator this
+        # digest needs, instead of one host modexp per ciphertext. On a
+        # worker thread: in single-process deployments this event loop also
+        # serves the proxy and replicas, and a large digest's dispatch must
+        # not stall them (the proxy's folds make the same to_thread hop).
+        count = self._psse_encrypts_in(digest)
+        if count:
+            await asyncio.to_thread(self.provider.precompute_psse_blinds, count)
         report = RunReport()
         t0 = time.perf_counter()
         for instr in digest.payload:

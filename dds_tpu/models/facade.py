@@ -13,7 +13,7 @@ Ciphertext wire types (JSON-safe):
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from dds_tpu.models.keys import HEKeys
 
@@ -31,6 +31,14 @@ class HomoProvider:
     # PaillierPublicKey.blind_fast): ~5x cheaper per ciphertext on the
     # client, standard variant. False = textbook full-width r^n.
     fast_blinding: bool = True
+    # Bulk-encryption accelerator (a models.backend.CryptoBackend): when
+    # set, precompute_psse_blinds routes the full-width r^n obfuscator
+    # modexps through backend.powmod_batch (TPU/native) and PSSE encrypts
+    # drain the pool — each ciphertext still gets an independent fresh
+    # full-width obfuscator (textbook blinding, strictly stronger than
+    # the DJN default), only the modexp moves off the host hot loop.
+    bulk_backend: object = None
+    _blind_pool: list = field(default_factory=list, repr=False, compare=False)
 
     @staticmethod
     def generate(paillier_bits: int = 2048, rsa_bits: int = 1024,
@@ -38,6 +46,18 @@ class HomoProvider:
         return HomoProvider(
             HEKeys.generate(paillier_bits, rsa_bits), fast_blinding=fast_blinding
         )
+
+    def precompute_psse_blinds(self, count: int, min_batch: int = 64) -> int:
+        """Fill the obfuscator pool for `count` upcoming PSSE encrypts via
+        the bulk backend's batched modexp; no-op (returns 0) without a
+        backend or below the amortization threshold — per-op paths are
+        faster there."""
+        if self.bulk_backend is None or count < min_batch:
+            return 0
+        self._blind_pool.extend(
+            self.keys.psse.public.blind_batch(count, self.bulk_backend, min_batch)
+        )
+        return count
 
     def encrypt(self, value, tag: str):
         k = self.keys
@@ -49,6 +69,10 @@ class HomoProvider:
             case "CHE":
                 return k.che.encrypt(str(value))
             case "PSSE":
+                if self._blind_pool:  # precomputed batch obfuscator
+                    return str(
+                        k.psse.public.encrypt(int(value), rn=self._blind_pool.pop())
+                    )
                 if self.fast_blinding:
                     return str(k.psse.public.encrypt_fast(int(value)))
                 return str(k.psse.public.encrypt(int(value)))

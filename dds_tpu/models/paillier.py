@@ -106,6 +106,35 @@ class PaillierPublicKey:
         """enc(m) with a blind_fast() obfuscator (DJN variant, see above)."""
         return self.encrypt(m, rn=self.blind_fast())
 
+    def blind_batch(self, count: int, backend=None, min_batch: int = 64) -> list[int]:
+        """`count` fresh FULL-WIDTH obfuscators r^n mod n^2 — textbook
+        blinding, each with an independent random r (contrast blind_fast's
+        DJN short exponents). A shared n-bit exponent over varying random
+        bases is exactly `CryptoBackend.powmod_batch`'s contract: this is
+        the encrypt-grade modexp of the reference's client hot loop
+        (`utils/SJHomoLibProvider.scala:74-86` encryptFully) routed through
+        the batched TPU ladder. Below `min_batch`, or with no backend, a
+        host loop (the per-op DJN path stays better for single encrypts)."""
+        rs = [self.random_r() for _ in range(count)]
+        if backend is not None and count >= min_batch:
+            # chunked dispatches bound the limb-array allocation (8192 rows
+            # x L limbs x 4 B = ~8 MB at Paillier-2048's L=256) so a huge
+            # digest cannot balloon host/device memory in one call
+            out: list[int] = []
+            for i in range(0, count, 8192):
+                out.extend(
+                    backend.powmod_batch(rs[i : i + 8192], self.n, self.nsquare)
+                )
+            return out
+        n2 = self.nsquare
+        return [powmod(r, self.n, n2) for r in rs]
+
+    def encrypt_batch(self, ms: list[int], backend=None, min_batch: int = 64) -> list[int]:
+        """Bulk enc(m; r) with per-message full-width obfuscators from
+        blind_batch (semantically the textbook scheme, not DJN)."""
+        rns = self.blind_batch(len(ms), backend, min_batch)
+        return [self.encrypt(m, rn=rn) for m, rn in zip(ms, rns)]
+
     def random_r(self) -> int:
         n = self.n
         while True:

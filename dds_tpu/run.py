@@ -361,7 +361,12 @@ def load_provider(cfg: DDSConfig) -> HomoProvider:
 
             # born 0600: these private keys decrypt the whole store
             write_secret_file(pathlib.Path(c.he_keys_path), keys.to_json())
-    return HomoProvider(keys, fast_blinding=c.fast_blinding)
+    bulk = None
+    if c.bulk_encrypt_backend:
+        from dds_tpu.models.backend import get_backend
+
+        bulk = get_backend(c.bulk_encrypt_backend)
+    return HomoProvider(keys, fast_blinding=c.fast_blinding, bulk_backend=bulk)
 
 
 async def run_workload(dep: Deployment, provider: HomoProvider | None = None,

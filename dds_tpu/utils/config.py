@@ -152,6 +152,11 @@ class ClientSettings:
     # (models/paillier.py blind_fast — ~5x cheaper per ciphertext, rests on
     # the DJN subgroup assumption), False = textbook full-width r^n.
     fast_blinding: bool = True
+    # route bulk client-side encryption (workload PutSet rows) through this
+    # CryptoBackend's batched modexp ("tpu" | "native"; empty = host per-op
+    # DJN path). Above the batch threshold one device dispatch precomputes
+    # every full-width obfuscator a digest needs.
+    bulk_encrypt_backend: str = ""
 
 
 @dataclass

@@ -339,3 +339,45 @@ def test_fast_blinding_knob_and_scaled_s_bits():
     assert PaillierPublicKey(1 << 3071)._djn_s_bits() == 512
     assert PaillierPublicKey(1 << 4095)._djn_s_bits() == 608
     assert PaillierPublicKey(1 << 1023)._djn_s_bits() == 320
+
+
+def test_workload_bulk_encrypt_backend_batches_obfuscators():
+    """client.bulk-encrypt-backend routes a digest's PSSE obfuscator
+    modexps through ONE batched backend dispatch (full-width exponent),
+    and the workload still completes — the encrypt-grade modexp wiring of
+    r4 verdict #3, driven through launch() + run_workload()."""
+    import asyncio as _asyncio
+
+    from dds_tpu.run import launch, load_provider, run_workload
+    from dds_tpu.utils.config import DDSConfig
+
+    async def go():
+        cfg = DDSConfig()
+        cfg.recovery.enabled = False
+        cfg.proxy.port = 0
+        cfg.client.nr_of_operations = 100
+        cfg.client.paillier_bits = 512
+        cfg.client.rsa_bits = 512
+        cfg.client.bulk_encrypt_backend = "tpu"
+        cfg.client.proportions = {"put-set": 0.9, "sum-all": 0.1}
+        provider = load_provider(cfg)
+        be = provider.bulk_backend
+        assert be is not None and be.name == "tpu"
+        be.min_device_batch = 0
+        calls = []
+        orig = be.powmod_batch
+        be.powmod_batch = lambda bases, exp, mod: calls.append(
+            (len(bases), exp.bit_length())
+        ) or orig(bases, exp, mod)
+
+        dep = await launch(cfg)
+        try:
+            reports = await run_workload(dep, provider=provider, seed=3)
+        finally:
+            await dep.stop()
+        assert all(r.failed == 0 for r in reports)
+        # one batched dispatch, full-width (n-bit) exponent, >= min_batch rows
+        assert calls and calls[0][0] >= 60 and calls[0][1] >= 511
+        assert len(provider._blind_pool) == 0  # drained by the PutSets
+
+    _asyncio.run(go())

@@ -167,3 +167,41 @@ def test_searchable_trapdoor_nonce_domain_separation():
     c = k.encrypt("alice")
     nonce_field = c.split(".")[0]
     assert k.trapdoor("siv|alice") != nonce_field[: len(k.trapdoor("siv|alice"))]
+
+
+# ------------------------------------------ bulk (encrypt-grade) modexp path
+
+def test_paillier_encrypt_batch_decrypts_through_tpu_backend():
+    """Bulk encryption routed through TpuBackend.powmod_batch with the
+    FULL-WIDTH n-bit exponent (the encrypt-grade modexp of r4 verdict #3):
+    every ciphertext must decrypt, obfuscators must be fresh per message."""
+    pk = KEYS.psse.public
+    be = get_backend("tpu")
+    be.min_device_batch = 0
+    ms = [rng.randrange(1 << 32) for _ in range(9)]
+    cts = pk.encrypt_batch(ms, backend=be, min_batch=1)
+    assert [KEYS.psse.decrypt(c) for c in cts] == ms
+    # same message twice -> different ciphertexts (independent obfuscators)
+    c1, c2 = pk.encrypt_batch([7, 7], backend=be, min_batch=1)
+    assert c1 != c2 and KEYS.psse.decrypt(c1) == KEYS.psse.decrypt(c2) == 7
+    # below min_batch: host loop, same contract
+    cts_host = pk.encrypt_batch(ms, backend=be, min_batch=10_000)
+    assert [KEYS.psse.decrypt(c) for c in cts_host] == ms
+
+
+def test_provider_blind_pool_feeds_psse_encrypts():
+    """precompute_psse_blinds fills the pool via the bulk backend; PSSE
+    encrypts drain it (fresh obfuscator each) and fall back to the DJN
+    path once empty."""
+    be = get_backend("tpu")
+    be.min_device_batch = 0
+    prov = HomoProvider(KEYS, bulk_backend=be)
+    assert prov.precompute_psse_blinds(4, min_batch=1) == 4
+    assert len(prov._blind_pool) == 4
+    cts = [int(prov.encrypt(i, "PSSE")) for i in range(5)]  # 4 pooled + 1 DJN
+    assert len(prov._blind_pool) == 0
+    assert [KEYS.psse.decrypt(c) for c in cts] == list(range(5))
+    # no backend -> precompute is a no-op and per-op paths serve
+    prov2 = HomoProvider(KEYS)
+    assert prov2.precompute_psse_blinds(100) == 0
+    assert KEYS.psse.decrypt(int(prov2.encrypt(42, "PSSE"))) == 42
