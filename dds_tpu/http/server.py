@@ -74,6 +74,13 @@ class ProxyConfig:
     key_sync_warmup: float = 1.0
     key_sync_interval: float = 5.0
     peers: list[str] = field(default_factory=list)  # "host:port"
+    # Cross-request fold coalescing: concurrent SumAll/MultAll folds that
+    # individually sit below the backend's device-batch crossover are
+    # gathered for coalesce_window seconds and dispatched as ONE segmented
+    # device fold (ops/foldmany), amortizing dispatch latency R ways. A
+    # group of one falls back to the plain host path, so the window only
+    # ever costs latency when there is something to gain. 0 disables.
+    coalesce_window: float = 0.002
     # stored_keys durability. The reference keeps the aggregate key set
     # in-memory only (`DDSRestServer.scala:70`), so a proxy restart makes
     # every aggregate silently shrink until re-population — flagged as a
@@ -128,6 +135,10 @@ class DDSRestServer:
         self._tasks: list[asyncio.Task] = []
         self._keys_dirty = False
         self._keys_saver: asyncio.Task | None = None
+        # modulus -> [(operands, future)]; drained by _drain_folds
+        self._fold_pending: dict[int, list] = {}
+        self._fold_drainer: asyncio.Task | None = None
+        self._folds_inflight = 0  # folds currently executing (any path)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -152,6 +163,21 @@ class DDSRestServer:
             except asyncio.CancelledError:
                 pass
         self._tasks.clear()
+        if self._fold_drainer is not None and not self._fold_drainer.done():
+            # resolve queued folds before teardown so no request future is
+            # orphaned and no task outlives the server
+            self._fold_drainer.cancel()
+            try:
+                await self._fold_drainer
+            except asyncio.CancelledError:
+                pass
+            err = ConnectionError("proxy stopping")
+            for _, group in self._fold_pending.items():
+                for _, fut in group:
+                    if not fut.done():
+                        fut.set_exception(err)
+            self._fold_pending.clear()
+            self._fold_drainer = None
         if self._keys_saver is not None:
             self._keys_saver.cancel()
             try:
@@ -766,12 +792,9 @@ class DDSRestServer:
             # The fold runs in a worker thread so concurrent aggregate
             # requests overlap their device dispatches (and the event loop
             # keeps serving) instead of serializing on a blocking fetch.
-            fold = getattr(
-                self.backend, "modmul_fold_resident", self.backend.modmul_fold
-            )
             with tracer.span("proxy.fold", k=len(operands),
                              backend=self.backend.name):
-                result = await asyncio.to_thread(fold, operands, modulus)
+                result = await self._fold(operands, modulus)
         elif modparam == "nsqr":
             result = sum(operands)
         else:
@@ -779,6 +802,68 @@ class DDSRestServer:
             for o in operands:
                 result *= o
         return Response.json(J.value_result(str(result)))
+
+    async def _fold(self, operands: list[int], modulus: int):
+        """Dispatch one aggregate's fold: wide folds go straight to the
+        backend on a worker thread; small folds (below the device-batch
+        crossover, where dispatch latency beats the math) enter the
+        coalescing window so CONCURRENT small aggregates share one
+        segmented device dispatch (ProxyConfig.coalesce_window).
+
+        A small fold only enters the window when other folds are already
+        executing or queued — observed concurrency is the signal there is
+        something to coalesce with; a lone request pays zero extra latency."""
+        be = self.backend
+        fold = getattr(be, "modmul_fold_resident", be.modmul_fold)
+        min_batch = getattr(be, "min_device_batch", 0)
+        concurrent = self._folds_inflight > 0 or bool(self._fold_pending)
+        if (
+            self.cfg.coalesce_window <= 0
+            or not hasattr(be, "modmul_fold_many")
+            or len(operands) >= min_batch
+            or not concurrent
+        ):
+            self._folds_inflight += 1
+            try:
+                return await asyncio.to_thread(fold, operands, modulus)
+            finally:
+                self._folds_inflight -= 1
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._fold_pending.setdefault(modulus, []).append((operands, fut))
+        if self._fold_drainer is None or self._fold_drainer.done():
+            self._fold_drainer = asyncio.ensure_future(self._drain_folds())
+        return await fut
+
+    async def _drain_folds(self) -> None:
+        await asyncio.sleep(self.cfg.coalesce_window)
+        while self._fold_pending:
+            modulus, group = self._fold_pending.popitem()
+            folds = [ops_ for ops_, _ in group]
+            futs = [f for _, f in group]
+            self._folds_inflight += 1
+            try:
+                if len(folds) == 1:
+                    # nothing to coalesce: plain host path (device dispatch
+                    # for one small fold is the regime that loses)
+                    fold = getattr(
+                        self.backend, "modmul_fold_resident",
+                        self.backend.modmul_fold,
+                    )
+                    results = [await asyncio.to_thread(fold, folds[0], modulus)]
+                else:
+                    results = await asyncio.to_thread(
+                        self.backend.modmul_fold_many, folds, modulus
+                    )
+                for f, r in zip(futs, results):
+                    if not f.cancelled():
+                        f.set_result(r)
+            except Exception as e:  # surface to every waiting request
+                for f in futs:
+                    if not f.cancelled():
+                        f.set_exception(e)
+            finally:
+                self._folds_inflight -= 1
 
     @staticmethod
     def _pos(req: Request) -> int:

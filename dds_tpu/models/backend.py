@@ -197,6 +197,16 @@ class TpuBackend:
         out = self.reduce_mul_device(ctx, batch)
         return bn.limbs_to_int(np.asarray(out)[0])
 
+    def modmul_fold_many(self, folds: list[list[int]], modulus: int) -> list[int]:
+        """Fold R requests' operand lists in ONE device dispatch
+        (ops/foldmany): the cross-request batching for concurrent small
+        aggregates that individually sit below min_device_batch."""
+        from dds_tpu.ops import foldmany
+
+        return foldmany.fold_many(
+            folds, modulus, kernel=self.kernel if self.pallas else "jnp"
+        )
+
     def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
         ctx = ModCtx.make(modulus)
         batch = bn.ints_to_batch(bases, ctx.L)

@@ -1,0 +1,114 @@
+"""Segmented (multi-request) modular-product folds in ONE device dispatch.
+
+The small-aggregate regime problem (BASELINE.md config 5): a single
+SumAll over K < ~1k sets loses to a host fold because flat dispatch
+latency dominates. But a proxy serving CONCURRENT small aggregates can
+coalesce them — R requests' folds become one (P2*R, L) elem-major batch
+that tree-reduces in one dispatch, amortizing the latency R ways (the
+"consensus batch" idea of SURVEY.md §7 applied to the query plane;
+the reference folds each aggregate separately and sequentially,
+`dds/http/DDSRestServer.scala:397-446`).
+
+Layout: row elem*R + req, so level halving `x[:h*R] * x[h*R:2h*R]`
+multiplies elem i with elem i+h within every request at once. Each
+request pads to the shared P2 with the Montgomery identity; the per-
+request R^-(K_r-1) power is fixed with one final multiply by R^K_r
+(same accounting as ModCtx.reduce_mul). All requests share one modulus —
+the coalescer groups by modulus.
+
+Compiled executables retrace per (P2, R); both axes are bucketed to
+powers of two by the caller so the shape set stays tiny.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dds_tpu.ops import bignum as bn
+from dds_tpu.ops.montgomery import ModCtx, _mont_mul_raw
+
+_FN_CACHE: dict = {}
+_FN_CACHE_MAX = 64
+_FN_CACHE_LOCK = threading.Lock()
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _mul_bm(ctx: ModCtx, kernel: str, interpret: bool):
+    """Batch-major (B, L) Montgomery multiply for the kernel family
+    (mirrors parallel/mesh._local_fold_fn's selection)."""
+    if kernel == "v2":
+        from dds_tpu.ops import mont_mxu
+
+        mctx = mont_mxu.MxuCtx.make(ctx)
+        karatsuba = mont_mxu._use_karatsuba()
+        return lambda a, b: mont_mxu.mul2_lm(mctx, a.T, b.T, interpret, karatsuba).T
+    if kernel == "v1":
+        from dds_tpu.ops import pallas_mont
+
+        return lambda a, b: pallas_mont.mul_lm(ctx, a.T, b.T, interpret=interpret).T
+    N = jnp.asarray(ctx.N)
+    n0inv = jnp.uint32(ctx.n0inv)
+    return lambda a, b: _mont_mul_raw(a, b, N, n0inv)
+
+
+def _fold_many_fn(ctx: ModCtx, kernel: str, R: int):
+    key = (ctx.n, kernel, R)
+    fn = _FN_CACHE.get(key)
+    if fn is not None:
+        return fn
+    mul = _mul_bm(ctx, kernel, _interpret_default())
+
+    def run(arr, fixes):
+        # arr: (P2*R, L) elem-major plain-domain; fixes: (R, L) = R^K_r
+        w = arr.shape[0] // R
+        x = arr
+        while w > 1:
+            h = w // 2
+            x = mul(x[: h * R], x[h * R : 2 * h * R])
+            w = h
+        return mul(x, fixes)                       # (R, L) plain domain
+
+    fn = jax.jit(run)
+    with _FN_CACHE_LOCK:
+        while len(_FN_CACHE) >= _FN_CACHE_MAX:
+            _FN_CACHE.pop(next(iter(_FN_CACHE)), None)
+        _FN_CACHE[key] = fn
+    return fn
+
+
+def fold_many(folds: list[list[int]], modulus: int, kernel: str = "jnp") -> list[int]:
+    """Modular product of each request's operand list, one device dispatch.
+
+    Pads every fold to the shared power-of-two width and the request axis
+    to a power of two (dummy folds of [1]) so compiled shapes stay few.
+    """
+    ctx = ModCtx.make(modulus)
+    R_real = len(folds)
+    Rp = 1 << max(0, (R_real - 1).bit_length())
+    Kmax = max(len(f) for f in folds)
+    P2 = 1 << max(0, (Kmax - 1).bit_length())
+
+    arr = np.empty((P2, Rp, ctx.L), np.uint32)
+    arr[:] = ctx.one_mont  # identity pads (elem pads + dummy requests)
+    for r, f in enumerate(folds):
+        arr[: len(f), r, :] = bn.ints_to_batch(f, ctx.L)
+    R_ = 1 << (bn.LIMB_BITS * ctx.L)
+    fixes = np.stack(
+        [
+            bn.int_to_limbs(pow(R_ % ctx.n, len(f), ctx.n), ctx.L)
+            for f in folds
+        ]
+        + [bn.int_to_limbs(R_ % ctx.n, ctx.L)] * (Rp - R_real)  # dummies: K=1
+    )
+    out = _fold_many_fn(ctx, kernel, Rp)(
+        jnp.asarray(arr.reshape(P2 * Rp, ctx.L)), jnp.asarray(fixes)
+    )
+    return [bn.limbs_to_int(row) for row in np.asarray(out)[:R_real]]

@@ -302,6 +302,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             key_sync_interval=cfg.proxy.key_sync_interval,
             peers=cfg.proxy.remote_peers,
             keys_path=cfg.proxy.stored_keys_path,
+            coalesce_window=cfg.proxy.coalesce_window,
             supervisor=sup_addr,
             trace_route_enabled=cfg.debug,
             ssl_server_context=ssl_server,

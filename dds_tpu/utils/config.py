@@ -101,6 +101,9 @@ class ProxySettings:
     # lossy behavior); restarted proxies also pull keys from remote_peers
     # at start when key_sync_enabled
     stored_keys_path: str = ""
+    # gather window (s) for coalescing concurrent small aggregate folds
+    # into one device dispatch; 0 disables
+    coalesce_window: float = 0.002
 
 
 @dataclass

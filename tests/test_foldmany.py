@@ -1,0 +1,45 @@
+"""Segmented multi-request folds (ops/foldmany): one dispatch, R results."""
+
+import random
+
+import pytest
+
+from dds_tpu.ops import foldmany
+
+rng = random.Random(17)
+
+
+def _want(f, n):
+    acc = 1
+    for c in f:
+        acc = acc * c % n
+    return acc
+
+
+@pytest.mark.parametrize("kernel", ["jnp", "v2"])
+def test_fold_many_ragged_matches_int(kernel):
+    n = rng.getrandbits(512) | (1 << 511) | 1
+    folds = [
+        [rng.randrange(1, n) for _ in range(k)] for k in (1, 3, 8, 13, 40)
+    ]
+    got = foldmany.fold_many(folds, n, kernel=kernel)
+    assert got == [_want(f, n) for f in folds]
+
+
+def test_fold_many_single_request_and_request_padding():
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    # R=3 pads the request axis to 4 with dummy folds; results must be exact
+    folds = [[rng.randrange(1, n) for _ in range(5)] for _ in range(3)]
+    assert foldmany.fold_many(folds, n) == [_want(f, n) for f in folds]
+    # R=1 degenerates to a plain fold
+    one = [[rng.randrange(1, n) for _ in range(9)]]
+    assert foldmany.fold_many(one, n) == [_want(one[0], n)]
+
+
+def test_backend_fold_many_dispatches_kernel_family():
+    from dds_tpu.models.backend import TpuBackend
+
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    folds = [[rng.randrange(1, n) for _ in range(4)] for _ in range(2)]
+    be = TpuBackend(pallas=True, kernel="v2", min_device_batch=0)
+    assert be.modmul_fold_many(folds, n) == [_want(f, n) for f in folds]

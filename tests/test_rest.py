@@ -517,3 +517,58 @@ def test_stored_keys_bootstrap_pull_from_peer_on_start():
             await s1.stop()
 
     asyncio.run(go())
+
+
+def test_concurrent_small_sumalls_coalesce_into_one_dispatch():
+    """R concurrent below-crossover SumAlls must share ONE segmented device
+    dispatch (ops/foldmany) and still decrypt to the right totals — the
+    cross-request batching of r4 verdict #2."""
+    from dds_tpu.models.backend import TpuBackend
+
+    async def go():
+        async with rest_stack() as (server, _, _):
+            be = TpuBackend(pallas=False, min_device_batch=10_000)
+            calls = {"many": 0, "single": 0}
+            orig_many = be.modmul_fold_many
+            orig_res = be.modmul_fold_resident
+            be.modmul_fold_many = lambda folds, mod: (
+                calls.__setitem__("many", calls["many"] + 1) or orig_many(folds, mod)
+            )
+            be.modmul_fold_resident = lambda cs, mod: (
+                calls.__setitem__("single", calls["single"] + 1) or orig_res(cs, mod)
+            )
+            server.backend = be
+            pk = KEYS.psse.public
+            vals = [rng.randrange(1 << 24) for _ in range(6)]
+            for v in vals:
+                await call(server, "POST", "/PutSet", {"contents": [str(pk.encrypt(v))]})
+
+            # 5 concurrent SumAlls: the first (no observed concurrency)
+            # takes the host path; the 4 that arrive while it executes
+            # share ONE coalesced dispatch
+            results = await asyncio.gather(*(
+                call(server, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}")
+                for _ in range(5)
+            ))
+            for status, data in results:
+                assert status == 200
+                assert KEYS.psse.decrypt(int(json.loads(data)["result"])) == sum(vals)
+            assert calls["many"] == 1 and calls["single"] == 1
+
+            # a lone small aggregate pays NO window: straight host path
+            status, data = await call(
+                server, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}"
+            )
+            assert status == 200
+            assert KEYS.psse.decrypt(int(json.loads(data)["result"])) == sum(vals)
+            assert calls["many"] == 1 and calls["single"] == 2
+
+            # window 0 disables coalescing entirely
+            server.cfg.coalesce_window = 0.0
+            await asyncio.gather(*(
+                call(server, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}")
+                for _ in range(3)
+            ))
+            assert calls["many"] == 1 and calls["single"] == 5
+
+    asyncio.run(go())
