@@ -1,0 +1,100 @@
+"""Measured throughput cost of the aggregate-cache audit knob.
+
+Quantifies the other half of r4 verdict #8: each unit of
+`aggregate_cache_audit` adds one full ABD quorum read per aggregate
+round (the forgery-persistence side is the analytic bound + Monte Carlo
+in tests/test_tag_cache.py::test_audit_persistence_bound_monte_carlo).
+
+To isolate the protocol cost, rows store SMALL PLAIN integers and
+`SumAll` runs without `nsqr` (plain integer sum) — the fold is then
+microseconds, so the measured per-request delta between audit settings
+is the audit's quorum-read cost, not crypto time. K defaults to 8192
+(the documented operating point).
+
+Usage: python -m benchmarks.audit_cost [--k 8192] [--audits 0 2 4 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+from benchmarks.common import emit
+
+METRIC = "SumAll requests/sec vs aggregate_cache_audit @ K stored sets"
+
+
+async def run(k: int, audits: list[int], requests: int) -> list[dict]:
+    from dds_tpu.http.miniserver import http_request
+    from dds_tpu.run import launch
+    from dds_tpu.utils.config import DDSConfig
+
+    cfg = DDSConfig()
+    cfg.replicas.endpoints = [f"replica-{i}" for i in range(4)]
+    cfg.replicas.sentinent = []
+    cfg.replicas.byz_quorum_size = 3
+    cfg.replicas.byz_max_faults = 1
+    cfg.recovery.enabled = False
+    cfg.proxy.port = 0
+
+    dep = await launch(cfg)
+    out = []
+    try:
+        host, port = "127.0.0.1", dep.server.cfg.port
+        sem = asyncio.Semaphore(64)
+
+        async def put(i):
+            async with sem:
+                body = json.dumps({"contents": [i]}).encode()
+                return await http_request(host, port, "POST", "/PutSet", body)
+
+        statuses = await asyncio.gather(*(put(i) for i in range(k)))
+        assert all(s == 200 for s, _ in statuses)
+
+        target = "/SumAll?position=0"
+        want = str(sum(range(k)))
+        for audit in audits:
+            dep.server.cfg.aggregate_cache_audit = audit
+            # warm the cache + memos for this setting
+            st, body = await http_request(host, port, "GET", target, timeout=120.0)
+            assert st == 200 and json.loads(body)["result"] == want
+            t0 = time.perf_counter()
+            for _ in range(requests):
+                st, _ = await http_request(host, port, "GET", target, timeout=120.0)
+                assert st == 200
+            per = (time.perf_counter() - t0) / requests
+            out.append({"audit": audit, "req_per_sec": 1 / per, "ms": per * 1e3})
+    finally:
+        await dep.stop()
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=8192)
+    ap.add_argument("--audits", type=int, nargs="+", default=[0, 2, 4, 8])
+    ap.add_argument("--requests", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    results = asyncio.run(run(args.k, args.audits, args.requests))
+    base = next((r for r in results if r["audit"] == 0), results[0])
+    rows = []
+    for r in results:
+        rows.append(
+            emit(
+                METRIC,
+                r["req_per_sec"],
+                "req/s",
+                r["req_per_sec"] / base["req_per_sec"],
+                audit=r["audit"],
+                K=args.k,
+                sumall_ms=round(r["ms"], 2),
+            )
+        )
+    return rows
+
+
+if __name__ == "__main__":
+    main()

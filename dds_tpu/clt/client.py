@@ -105,9 +105,12 @@ class DDSHttpClient:
         # worker thread: in single-process deployments this event loop also
         # serves the proxy and replicas, and a large digest's dispatch must
         # not stall them (the proxy's folds make the same to_thread hop).
-        count = self._psse_encrypts_in(digest)
-        if count:
-            await asyncio.to_thread(self.provider.precompute_psse_blinds, count)
+        if self.provider.bulk_backend is not None:
+            count = self._psse_encrypts_in(digest)
+            if count:
+                await asyncio.to_thread(
+                    self.provider.precompute_psse_blinds, count
+                )
         report = RunReport()
         t0 = time.perf_counter()
         for instr in digest.payload:

@@ -67,7 +67,15 @@ class ProxyConfig:
     # what the cache must not add is *persistence*. Even with f colluding
     # coordinators defeating one corroboration round, a forged entry
     # survives future audits only until one samples it through an honest
-    # coordinator — expected ~K/(2*audit) aggregate rounds at K cached keys.
+    # coordinator. Quantified bound (Monte-Carlo-checked in
+    # tests/test_tag_cache.py::test_audit_persistence_bound_monte_carlo):
+    # detection per aggregate round is geometric with
+    #   p = (audit/K) * (n-f)/n
+    # (sampled AND audited through an honest coordinator), so expected
+    # persistence = K/audit * n/(n-f) rounds — at K=8192, audit=2, n=4,
+    # f=1: ~5,461 aggregate rounds; audit=4 halves it, 8 quarters it.
+    # Measured throughput cost of raising it: benchmarks/audit_cost.py
+    # (each audit key adds one full ABD read per aggregate).
     aggregate_cache_audit: int = 2
     # proxy->proxy key gossip (DDSRestServer.scala:118-136)
     key_sync_enabled: bool = False
@@ -838,32 +846,48 @@ class DDSRestServer:
     async def _drain_folds(self) -> None:
         await asyncio.sleep(self.cfg.coalesce_window)
         while self._fold_pending:
-            modulus, group = self._fold_pending.popitem()
-            folds = [ops_ for ops_, _ in group]
-            futs = [f for _, f in group]
-            self._folds_inflight += 1
-            try:
-                if len(folds) == 1:
-                    # nothing to coalesce: plain host path (device dispatch
-                    # for one small fold is the regime that loses)
-                    fold = getattr(
-                        self.backend, "modmul_fold_resident",
-                        self.backend.modmul_fold,
-                    )
-                    results = [await asyncio.to_thread(fold, folds[0], modulus)]
-                else:
-                    results = await asyncio.to_thread(
-                        self.backend.modmul_fold_many, folds, modulus
-                    )
-                for f, r in zip(futs, results):
-                    if not f.cancelled():
-                        f.set_result(r)
-            except Exception as e:  # surface to every waiting request
-                for f in futs:
-                    if not f.cancelled():
-                        f.set_exception(e)
-            finally:
-                self._folds_inflight -= 1
+            # snapshot ALL pending groups and dispatch them concurrently:
+            # different moduli must overlap their dispatches (the whole
+            # point of folding in threads), and draining one at a time
+            # would let a continuously re-queued hot modulus starve others
+            groups = list(self._fold_pending.items())
+            self._fold_pending.clear()
+            await asyncio.gather(
+                *(self._dispatch_fold_group(m, g) for m, g in groups)
+            )
+
+    async def _dispatch_fold_group(self, modulus: int, group: list) -> None:
+        folds = [ops_ for ops_, _ in group]
+        futs = [f for _, f in group]
+        self._folds_inflight += 1
+        try:
+            if len(folds) == 1:
+                # nothing to coalesce: plain host path (device dispatch
+                # for one small fold is the regime that loses)
+                fold = getattr(
+                    self.backend, "modmul_fold_resident",
+                    self.backend.modmul_fold,
+                )
+                results = [await asyncio.to_thread(fold, folds[0], modulus)]
+            else:
+                results = await asyncio.to_thread(
+                    self.backend.modmul_fold_many, folds, modulus
+                )
+            for f, r in zip(futs, results):
+                if not f.cancelled():
+                    f.set_result(r)
+        except Exception as e:  # surface to every waiting request
+            for f in futs:
+                if not f.cancelled():
+                    f.set_exception(e)
+        finally:
+            self._folds_inflight -= 1
+            # a cancellation (e.g. stop() mid-dispatch) must not orphan
+            # the group: its futures are no longer in _fold_pending, so
+            # stop()'s sweep cannot see them — fail them here
+            for f in futs:
+                if not f.done():
+                    f.set_exception(ConnectionError("proxy stopping"))
 
     @staticmethod
     def _pos(req: Request) -> int:

@@ -153,10 +153,11 @@ class TpuBackend:
         return c1 * c2 % modulus
 
     def _mesh_kernel(self) -> str:
-        """Kernel family for the shard-local math under a mesh: the SAME
-        one the single-chip path would use (v1/v2 when pallas is on, the
-        portable jnp scans otherwise) — N chips must mean N x the fast
-        kernel, not N x the portable one (parallel/mesh.py docstring)."""
+        """The single kernel-family rule for every composite fold path —
+        mesh-sharded (parallel/mesh.py) AND coalesced (ops/foldmany):
+        the SAME family the single-chip path would use (v1/v2 when pallas
+        is on, the portable jnp scans otherwise), so scale-out and
+        batching never silently run a slower kernel."""
         return self.kernel if self.pallas else "jnp"
 
     def _get_mesh(self):
@@ -203,9 +204,7 @@ class TpuBackend:
         aggregates that individually sit below min_device_batch."""
         from dds_tpu.ops import foldmany
 
-        return foldmany.fold_many(
-            folds, modulus, kernel=self.kernel if self.pallas else "jnp"
-        )
+        return foldmany.fold_many(folds, modulus, kernel=self._mesh_kernel())
 
     def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
         ctx = ModCtx.make(modulus)

@@ -544,8 +544,11 @@ def test_concurrent_small_sumalls_coalesce_into_one_dispatch():
                 await call(server, "POST", "/PutSet", {"contents": [str(pk.encrypt(v))]})
 
             # 5 concurrent SumAlls: the first (no observed concurrency)
-            # takes the host path; the 4 that arrive while it executes
-            # share ONE coalesced dispatch
+            # takes the host path; later arrivals that see it in flight
+            # coalesce. Exact counts are timing-dependent (the first host
+            # fold may finish before a peer arrives), so assert the shape:
+            # at least one coalesced dispatch happened, every result is
+            # correct, and dispatches never exceeded request count.
             results = await asyncio.gather(*(
                 call(server, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}")
                 for _ in range(5)
@@ -553,22 +556,28 @@ def test_concurrent_small_sumalls_coalesce_into_one_dispatch():
             for status, data in results:
                 assert status == 200
                 assert KEYS.psse.decrypt(int(json.loads(data)["result"])) == sum(vals)
-            assert calls["many"] == 1 and calls["single"] == 1
+            assert calls["many"] >= 1
+            assert calls["many"] + calls["single"] < 5
 
             # a lone small aggregate pays NO window: straight host path
+            # (deterministic: nothing in flight, nothing pending)
+            before = dict(calls)
             status, data = await call(
                 server, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}"
             )
             assert status == 200
             assert KEYS.psse.decrypt(int(json.loads(data)["result"])) == sum(vals)
-            assert calls["many"] == 1 and calls["single"] == 2
+            assert calls["many"] == before["many"]
+            assert calls["single"] == before["single"] + 1
 
             # window 0 disables coalescing entirely
             server.cfg.coalesce_window = 0.0
+            before = dict(calls)
             await asyncio.gather(*(
                 call(server, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}")
                 for _ in range(3)
             ))
-            assert calls["many"] == 1 and calls["single"] == 5
+            assert calls["many"] == before["many"]
+            assert calls["single"] == before["single"] + 3
 
     asyncio.run(go())
