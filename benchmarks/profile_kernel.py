@@ -61,6 +61,81 @@ def make_nofinal_mul(L, Lt, TB):
     return call
 
 
+def vpu_mul_rate() -> float:
+    """Achieved VPU u32 multiply+mask rate (L-independent probe)."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.integers(0, 1 << 16, size=(512, 65536), dtype=np.uint32))
+
+    @jax.jit
+    def muls(x):
+        y = x
+        for _ in range(32):
+            y = (y * x) & np.uint32(0xFFFF)
+        return y.sum()
+
+    return 32 * x.size / timeit(muls, x)           # u32 mul+mask / s
+
+
+def roofline(L: int, vpu_rate: float):
+    """Per-modmul roofline for the v2 kernel at limb count L (r4 verdict
+    #4): from the achieved VPU u32-multiply rate and the MXU int8 MAC
+    rate at this L's REDC shape, derive the floor time a v2 Montgomery
+    multiply cannot beat.
+
+    v2 cost model per modmul (base-2^16 digits, see ops/mont_mxu):
+    - product: L^2 u32 multiplies on the VPU (each with mask/shift/add
+      bookkeeping — the measured chain rate already includes one mask per
+      multiply, so the bound charges L^2 / chain_rate);
+    - REDC: two int8 band matmuls over L8=2L base-2^8 digits:
+      L8^2 + 2*L8^2 = 3*(2L)^2 = 12 L^2 int8 MACs (x2 for the
+      signed/mask split) on the MXU;
+    - carry normalization: ~5 full-width Kogge-Stone passes, bandwidth-
+      bound — not charged (the floor is compute-optimistic).
+    """
+    rng = np.random.default_rng(3)
+    Mi = jnp.asarray(rng.integers(-128, 127, size=(4 * L, 2 * L), dtype=np.int8))
+    Vi = jnp.asarray(rng.integers(-128, 127, size=(2 * L, 4096), dtype=np.int8))
+
+    @jax.jit
+    def mm(M, V):
+        return jax.lax.dot(M, V, preferred_element_type=jnp.int32).sum()
+
+    mxu_rate = (4 * L * 2 * L * 4096) / timeit(mm, Mi, Vi)  # int8 MAC/s
+
+    floor_s = (L * L) / vpu_rate + (2 * 12 * L * L) / mxu_rate
+    return mxu_rate, floor_s
+
+
+def roofline_report(bits_list=(1024, 2048, 4096)):
+    """Print the utilization table for BASELINE.md: moduli of `bits` (so
+    L = bits/16 limbs in the direct-modulus case; Paillier folds run at
+    2x that for n^2)."""
+    from dds_tpu.ops import mont_mxu
+
+    rng = np.random.default_rng(9)
+    vpu_rate = vpu_mul_rate()  # L-independent: measure once
+    for bits in bits_list:
+        n = (1 << bits) - 159  # odd, full-width
+        ctx = ModCtx.make(n)
+        L = ctx.L
+        mctx = mont_mxu.MxuCtx.make(ctx)
+        B = 8192
+        batch = jnp.asarray(
+            rng.integers(0, 1 << 16, size=(B, L), dtype=np.uint32)
+        )
+
+        f = jax.jit(lambda x: mont_mxu.mul2_lm(mctx, x.T, x.T).sum())
+        t = timeit(f, batch)
+        mxu_rate, floor_s = roofline(L, vpu_rate)
+        per = t / B
+        print(
+            f"L={L:4d} ({bits}-bit): v2 modmul {per*1e9:8.1f} ns | "
+            f"compute floor {floor_s*1e9:8.1f} ns | utilization "
+            f"{floor_s/per*100:5.1f}% | vpu {vpu_rate/1e12:.2f} T mul/s, "
+            f"mxu {mxu_rate/1e12:.1f} T MAC/s"
+        )
+
+
 def main():
     key = bench_paillier_key()
     ctx = ModCtx.make(key.nsquare)
@@ -128,6 +203,9 @@ def main():
 
     t_mf = timeit(mm_f32, Mf, Vf)
     print(f"f32 matmul  same shape: {t_mf*1e3:.2f} ms  {macs/t_mf/1e12:.1f} T MAC/s")
+
+    print("\n-- v2 roofline (measured vs compute floor) --")
+    roofline_report()
 
 
 if __name__ == "__main__":

@@ -18,7 +18,8 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args(argv)
 
-    from benchmarks import bft_sum, mixed, product, sweep
+    from benchmarks import (audit_cost, bft_sum, crossover, encrypt_modexp,
+                            mixed, product, put_concurrency, sweep)
 
     rows = []
     if args.quick:
@@ -26,11 +27,17 @@ def main(argv=None):
         rows += product.main(["--k", "1024", "--sizes", "1024"])
         rows += bft_sum.main(["--k", "32", "--requests", "2"])
         rows += mixed.main(["--ops", "60"])
+        rows += put_concurrency.main(["--ops", "32", "--clients", "1", "4"])
+        rows += audit_cost.main(["--k", "256", "--requests", "5"])
     else:
         rows += sweep.main([])
         rows += product.main([])
         rows += bft_sum.main([])
         rows += mixed.main([])
+        rows += put_concurrency.main([])
+        rows += audit_cost.main([])
+        rows += crossover.main([])
+        rows += encrypt_modexp.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

@@ -861,14 +861,20 @@ class DDSRestServer:
         futs = [f for _, f in group]
         self._folds_inflight += 1
         try:
-            if len(folds) == 1:
-                # nothing to coalesce: plain host path (device dispatch
-                # for one small fold is the regime that loses)
+            total = sum(len(f) for f in folds)
+            if len(folds) == 1 or total < getattr(
+                self.backend, "min_device_batch", 0
+            ):
+                # a lone fold, or a group whose COMBINED width is still
+                # below the device crossover: the host loop wins (one
+                # thread hop folds the whole group)
                 fold = getattr(
                     self.backend, "modmul_fold_resident",
                     self.backend.modmul_fold,
                 )
-                results = [await asyncio.to_thread(fold, folds[0], modulus)]
+                results = await asyncio.to_thread(
+                    lambda: [fold(f, modulus) for f in folds]
+                )
             else:
                 results = await asyncio.to_thread(
                     self.backend.modmul_fold_many, folds, modulus

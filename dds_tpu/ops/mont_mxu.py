@@ -56,6 +56,27 @@ PROD_TB = 128
 GROUP = 8                         # a-limbs per aligned accumulator update
 
 
+def _tb_for(L: int) -> int:
+    """Lane tile per limb count. Small-limb moduli (RSA-1024: L=64)
+    under-fill a 128-lane tile's fixed costs — wider tiles amortize them
+    while the (2L, TB) accumulator still fits VMEM easily (L=64, TB=512:
+    ~0.3 MB). Values are the measured winners of a DDS_PROD_TB sweep
+    (e.g. `DDS_PROD_TB=512 python -m benchmarks.product --sizes 1024`).
+    CAUTION: DDS_PROD_TB is read at TRACE time and the callers' jit/lru
+    caches key on shapes only — sweep with ONE PROCESS PER VALUE, never
+    by mutating the env mid-process (stale traces would be re-timed)."""
+    import os
+
+    env = os.environ.get("DDS_PROD_TB", "").strip()
+    if env:
+        return int(env)
+    if L <= 64:
+        return 512
+    if L <= 128:
+        return 256
+    return PROD_TB
+
+
 def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -166,16 +187,18 @@ def _pad_lanes(x, TB: int):
     return x, B
 
 
-def prod_lm(a, b, TB: int = PROD_TB, interpret: bool | None = None):
+def prod_lm(a, b, TB: int | None = None, interpret: bool | None = None):
     """Full product of canonical limbs-major operands: (L,B)x(L,B)->(2L,B).
 
     Handles any L: operands are zero-padded on the limb axis to a multiple
     of GROUP for the kernel (zero top limbs don't change the value) and the
     output is sliced back to 2L rows (the padded product's top rows are
-    provably zero)."""
+    provably zero). TB=None picks the measured per-L lane tile (_tb_for)."""
     if interpret is None:
         interpret = _interpret_default()
     L = a.shape[0]
+    if TB is None:
+        TB = _tb_for(L)
     Lp = ((L + GROUP - 1) // GROUP) * GROUP
     if Lp != L:
         a = jnp.pad(a, ((0, Lp - L), (0, 0)))
@@ -185,7 +208,7 @@ def prod_lm(a, b, TB: int = PROD_TB, interpret: bool | None = None):
     return _prod_call(Lp, a.shape[1], TB, interpret)(a, b)[: 2 * L, :B]
 
 
-def prod_lm_k1(a, b, TB: int = PROD_TB, interpret: bool | None = None):
+def prod_lm_k1(a, b, TB: int | None = None, interpret: bool | None = None):
     """One Karatsuba level over prod_lm: 3 half-size schoolbook products
     instead of 1 full-size one — 25% fewer VPU u32 multiplies, the v2
     kernel's dominant cost. Composed entirely from existing primitives:
@@ -223,6 +246,8 @@ def prod_lm_k1(a, b, TB: int = PROD_TB, interpret: bool | None = None):
     if interpret is None:
         interpret = _interpret_default()
     L = a.shape[0]
+    if TB is None:
+        TB = _tb_for(L)
     if L % 2 or (L // 2) % GROUP:
         return prod_lm(a, b, TB, interpret)
     h = L // 2

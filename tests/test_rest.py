@@ -527,7 +527,10 @@ def test_concurrent_small_sumalls_coalesce_into_one_dispatch():
 
     async def go():
         async with rest_stack() as (server, _, _):
-            be = TpuBackend(pallas=False, min_device_batch=10_000)
+            # each fold (K=6) is below the crossover (10) so requests enter
+            # the window; a group's combined width (>=2 x 6) clears it, so
+            # the coalesced dispatch goes to the device
+            be = TpuBackend(pallas=False, min_device_batch=10)
             calls = {"many": 0, "single": 0}
             orig_many = be.modmul_fold_many
             orig_res = be.modmul_fold_resident
