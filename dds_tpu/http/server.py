@@ -866,14 +866,16 @@ class DDSRestServer:
                 self.backend, "min_device_batch", 0
             ):
                 # a lone fold, or a group whose COMBINED width is still
-                # below the device crossover: the host loop wins (one
-                # thread hop folds the whole group)
+                # below the device crossover: host folds win there. One
+                # worker thread per fold (not one serial loop): native
+                # host folds release the GIL, so group members overlap
+                # exactly as they would have without the window
                 fold = getattr(
                     self.backend, "modmul_fold_resident",
                     self.backend.modmul_fold,
                 )
-                results = await asyncio.to_thread(
-                    lambda: [fold(f, modulus) for f in folds]
+                results = await asyncio.gather(
+                    *(asyncio.to_thread(fold, f, modulus) for f in folds)
                 )
             else:
                 results = await asyncio.to_thread(
