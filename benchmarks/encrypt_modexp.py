@@ -16,6 +16,10 @@ bits, L=256) at batch B for:
 - DJN:     the 448-bit short-exponent host path (what per-op encryption
            uses today) — the honest host contender for bulk encryption.
 
+Also measures batched CRT DECRYPT (PaillierKey.decrypt_batch: two
+half-width shared-exponent modexp legs on the device) vs the per-op host
+decrypt, decrypt-verified.
+
 vs_baseline = v2 sustained vs python pow.
 
 Usage: python -m benchmarks.encrypt_modexp [--b 256] [--repeats 3]
@@ -87,6 +91,23 @@ def main(argv=None):
 
     v1_sus = sustained_device(lambda: pallas_mont.pow_mod(ctx, dev, n), R=args.pipelined)
 
+    # batched CRT decrypt: device path (two half-width shared-exponent
+    # modexp legs) vs per-op host decrypt, verified
+    from dds_tpu.models.backend import TpuBackend
+
+    be = TpuBackend(min_device_batch=0)
+    ms_plain = [int(x) for x in rng.integers(0, 1 << 48, size=B)]
+    blinds = [pk.blind() for _ in range(32)]
+    cts = [pk.encrypt(m, rn=blinds[i % 32]) for i, m in enumerate(ms_plain)]
+    got = key.decrypt_batch(cts, backend=be, min_batch=1)
+    assert got == ms_plain, "batched CRT decrypt mismatch"
+    dec_dev = best_of(lambda: key.decrypt_batch(cts, backend=be, min_batch=1),
+                      repeats=2)
+    host_slice = cts[: max(8, B // 32)]
+    dec_host = best_of(lambda: [key.decrypt(c) for c in host_slice], repeats=2)
+    dec_dev_ops = B / dec_dev
+    dec_host_ops = len(host_slice) / dec_host
+
     row = emit(
         METRIC,
         B / v2_sus,
@@ -101,6 +122,9 @@ def main(argv=None):
         python_pow_ops=round(py_ops, 1),
         djn_short_exp_host_ops=round(djn_ops, 1),
         v2_ms_per_batch=round(v2_sus * 1e3, 1),
+        decrypt_batch_device_ops=round(dec_dev_ops, 1),
+        decrypt_host_ops=round(dec_host_ops, 1),
+        decrypt_speedup=round(dec_dev_ops / dec_host_ops, 2),
     )
     return [row]
 

@@ -109,3 +109,33 @@ class HomoProvider:
         fixed = [self.decrypt(v, schema[i]) for i, v in enumerate(row[:until])]
         variable = [self.decrypt(v, "None") for v in row[until:]]
         return fixed + variable
+
+    def decrypt_rows(self, rows: list[list], until: int, schema: list[str],
+                     min_batch: int = 64) -> list[list]:
+        """Bulk decrypt_row. With a bulk backend, all rows' PSSE columns
+        decrypt as ONE batched CRT modexp pair (PaillierKey.decrypt_batch
+        — the decrypt half of the reference's `decryptFully` hot loop,
+        `utils/SJHomoLibProvider.scala:89-101`); other schemes are cheap
+        per-op host work either way."""
+        if self.bulk_backend is None:
+            return [self.decrypt_row(r, until, schema) for r in rows]
+        cols = sorted(i for i, s in enumerate(schema[:until]) if s == "PSSE")
+        cts = [int(r[i]) for r in rows for i in cols if i < len(r)]
+        if len(cts) < min_batch:
+            return [self.decrypt_row(r, until, schema) for r in rows]
+        k = self.keys.psse
+        psse_cols = set(cols)
+        plains = iter(
+            k.decrypt_batch(cts, backend=self.bulk_backend, min_batch=min_batch)
+        )
+        out = []
+        for r in rows:
+            dec = []
+            for i, v in enumerate(r[:until]):
+                if i in psse_cols:
+                    dec.append(k.to_signed(next(plains)))
+                else:
+                    dec.append(self.decrypt(v, schema[i]))
+            dec.extend(self.decrypt(v, "None") for v in r[until:])
+            out.append(dec)
+        return out

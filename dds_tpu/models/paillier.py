@@ -18,6 +18,7 @@ of one full-size), the standard Paillier speedup (cf. PAPERS.md CRT-Paillier).
 
 from __future__ import annotations
 
+import functools
 import secrets
 from dataclasses import dataclass
 from math import gcd
@@ -34,6 +35,25 @@ def _lcm(a: int, b: int) -> int:
 # n -> B0 = r0^n mod n^2 for blind_fast (PaillierPublicKey is frozen;
 # one fixed random base per key per process is exactly the DJN setup)
 _B0_CACHE: dict[int, int] = {}
+
+
+@functools.lru_cache(maxsize=16)
+def _crt_params_cached(p: int, q: int, n: int):
+    """Per-key CRT constants (three modular inversions — not per-decrypt
+    work; keys are few and long-lived)."""
+    hp = pow((pow(1 + n, p - 1, p * p) - 1) // p, -1, p)
+    hq = pow((pow(1 + n, q - 1, q * q) - 1) // q, -1, q)
+    qinv = pow(q, -1, p)
+    return hp, hq, qinv
+
+
+def _chunked_powmod(backend, bases: list[int], exp: int, mod: int) -> list[int]:
+    """backend.powmod_batch in 8192-row chunks: bounds the (rows, L) limb
+    allocation per dispatch (~8 MB at L=256) for arbitrarily long batches."""
+    out: list[int] = []
+    for i in range(0, len(bases), 8192):
+        out.extend(backend.powmod_batch(bases[i : i + 8192], exp, mod))
+    return out
 
 
 @dataclass(frozen=True)
@@ -117,15 +137,7 @@ class PaillierPublicKey:
         host loop (the per-op DJN path stays better for single encrypts)."""
         rs = [self.random_r() for _ in range(count)]
         if backend is not None and count >= min_batch:
-            # chunked dispatches bound the limb-array allocation (8192 rows
-            # x L limbs x 4 B = ~8 MB at Paillier-2048's L=256) so a huge
-            # digest cannot balloon host/device memory in one call
-            out: list[int] = []
-            for i in range(0, count, 8192):
-                out.extend(
-                    backend.powmod_batch(rs[i : i + 8192], self.n, self.nsquare)
-                )
-            return out
+            return _chunked_powmod(backend, rs, self.n, self.nsquare)
         n2 = self.nsquare
         return [powmod(r, self.n, n2) for r in rs]
 
@@ -182,24 +194,51 @@ class PaillierKey:
     # -- decryption (CRT) ---------------------------------------------------
 
     def _crt_params(self):
-        p, q, n = self.p, self.q, self.n
-        hp = pow((pow(1 + n, p - 1, p * p) - 1) // p, -1, p)
-        hq = pow((pow(1 + n, q - 1, q * q) - 1) // q, -1, q)
-        qinv = pow(q, -1, p)
-        return hp, hq, qinv
+        return _crt_params_cached(self.p, self.q, self.n)
 
     def decrypt(self, c: int) -> int:
+        # the batch-of-one host path IS the per-op CRT decrypt; one body
+        return self.decrypt_batch([c])[0]
+
+    def decrypt_batch(self, cs: list[int], backend=None, min_batch: int = 64) -> list[int]:
+        """Bulk CRT decrypt. Both CRT legs use SHARED exponents (p-1 and
+        q-1) over varying ciphertext residues — exactly
+        `CryptoBackend.powmod_batch`'s contract, so the two half-width
+        modexp batches (the entire decrypt cost) run as two device
+        dispatches; the L-function/recombination tail is cheap host math.
+        This is the "decrypt" half of the north-star's "modular
+        exponentiations behind encrypt, decrypt" (BASELINE.json), the
+        reference's `decryptFully` loop (`utils/SJHomoLibProvider.scala:
+        89-101`). Below `min_batch`, or with no backend, the per-op host
+        path."""
         p, q, n = self.p, self.q, self.n
         hp, hq, qinv = self._crt_params()
-        mp = (powmod(c % (p * p), p - 1, p * p) - 1) // p % p * hp % p
-        mq = (powmod(c % (q * q), q - 1, q * q) - 1) // q % q * hq % q
-        u = (mp - mq) * qinv % p
-        return (mq + u * q) % n
+        p2, q2 = p * p, q * q
+        cps = [c % p2 for c in cs]
+        cqs = [c % q2 for c in cs]
+        if backend is not None and len(cs) >= min_batch:
+            xps = _chunked_powmod(backend, cps, p - 1, p2)
+            xqs = _chunked_powmod(backend, cqs, q - 1, q2)
+        else:
+            xps = [powmod(cp, p - 1, p2) for cp in cps]
+            xqs = [powmod(cq, q - 1, q2) for cq in cqs]
+        out = []
+        for xp, xq in zip(xps, xqs):
+            mp = (xp - 1) // p % p * hp % p
+            mq = (xq - 1) // q % q * hq % q
+            u = (mp - mq) * qinv % p
+            out.append((mq + u * q) % n)
+        return out
+
+    def to_signed(self, m: int) -> int:
+        """Map the upper half of Z_n back to negative ints — the ONE
+        signed-range convention, shared by decrypt_signed and the
+        facade's batched row decryption."""
+        return m - self.n if m > self.n // 2 else m
 
     def decrypt_signed(self, c: int) -> int:
         """Decrypt, mapping the upper half of Z_n back to negative ints."""
-        m = self.decrypt(c)
-        return m - self.n if m > self.n // 2 else m
+        return self.to_signed(self.decrypt(c))
 
     @property
     def lam(self) -> int:
