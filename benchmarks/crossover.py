@@ -37,32 +37,48 @@ def main(argv=None):
     ap.add_argument("--ks", type=int, nargs="+",
                     default=[32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384])
     ap.add_argument("--r", type=int, default=8, help="concurrent requests")
+    ap.add_argument("--host-only", action="store_true",
+                    help="measure only the host fold column (no device "
+                    "dispatches — usable while the TPU is unavailable; "
+                    "the host side of the curve is valid either way)")
     args = ap.parse_args(argv)
-
-    import jax
 
     from dds_tpu import native
     from dds_tpu.bench_key import bench_paillier_key
-    from dds_tpu.models.backend import TpuBackend
-    from dds_tpu.ops import bignum as bn
-    from dds_tpu.ops import foldmany
     from dds_tpu.ops.montgomery import ModCtx
 
     key = bench_paillier_key()
     n2 = key.public.nsquare
     ctx = ModCtx.make(n2)
-    be = TpuBackend(min_device_batch=0)
     rng = np.random.default_rng(7)
-    kernel = be.kernel if be.pallas else "jnp"
 
     kmax = max(args.ks)
     cs_int = [int.from_bytes(rng.bytes(ctx.L * 2), "little") % n2 for _ in range(kmax)]
-    batch_all = bn.ints_to_batch(cs_int, ctx.L)
+
+    if not args.host_only:
+        # device-path setup only when devices will be used: --host-only
+        # must work (and stay cheap) while the TPU is unavailable
+        import jax
+
+        from dds_tpu.models.backend import TpuBackend
+        from dds_tpu.ops import bignum as bn
+        from dds_tpu.ops import foldmany
+
+        be = TpuBackend(min_device_batch=0)
+        kernel = be.kernel if be.pallas else "jnp"
+        batch_all = bn.ints_to_batch(cs_int, ctx.L)
 
     rows = []
     for K in args.ks:
         cs = cs_int[:K]
         host_s = best_of(lambda: native.fold(cs, n2))
+
+        if args.host_only:
+            rows.append(
+                emit(METRIC, host_s * 1e3, "ms", 0.0, K=K,
+                     host_ms=round(host_s * 1e3, 3), host_only=True)
+            )
+            continue
 
         batch = np.asarray(batch_all[:K])
         dev = jax.device_put(batch)
@@ -97,6 +113,9 @@ def main(argv=None):
                 kernel=kernel,
             )
         )
+
+    if args.host_only:
+        return rows
 
     # name the crossovers for BASELINE.md
     def crossover(field):

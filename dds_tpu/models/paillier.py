@@ -37,16 +37,6 @@ def _lcm(a: int, b: int) -> int:
 _B0_CACHE: dict[int, int] = {}
 
 
-@functools.lru_cache(maxsize=16)
-def _crt_params_cached(p: int, q: int, n: int):
-    """Per-key CRT constants (three modular inversions — not per-decrypt
-    work; keys are few and long-lived)."""
-    hp = pow((pow(1 + n, p - 1, p * p) - 1) // p, -1, p)
-    hq = pow((pow(1 + n, q - 1, q * q) - 1) // q, -1, q)
-    qinv = pow(q, -1, p)
-    return hp, hq, qinv
-
-
 def _chunked_powmod(backend, bases: list[int], exp: int, mod: int) -> list[int]:
     """backend.powmod_batch in 8192-row chunks: bounds the (rows, L) limb
     allocation per dispatch (~8 MB at L=256) for arbitrarily long batches."""
@@ -193,8 +183,21 @@ class PaillierKey:
 
     # -- decryption (CRT) ---------------------------------------------------
 
+    @functools.cached_property
+    def _crt(self):
+        """Per-key CRT constants (three modular inversions, computed once).
+        A cached_property, NOT a module-level cache keyed on the primes:
+        the derived secrets live exactly as long as the key object does.
+        (cached_property writes the instance __dict__ directly, so it
+        works on this frozen dataclass.)"""
+        p, q, n = self.p, self.q, self.n
+        hp = pow((pow(1 + n, p - 1, p * p) - 1) // p, -1, p)
+        hq = pow((pow(1 + n, q - 1, q * q) - 1) // q, -1, q)
+        qinv = pow(q, -1, p)
+        return hp, hq, qinv
+
     def _crt_params(self):
-        return _crt_params_cached(self.p, self.q, self.n)
+        return self._crt
 
     def decrypt(self, c: int) -> int:
         # the batch-of-one host path IS the per-op CRT decrypt; one body

@@ -60,7 +60,8 @@ def _tb_for(L: int) -> int:
     """Lane tile per limb count. Small-limb moduli (RSA-1024: L=64)
     under-fill a 128-lane tile's fixed costs — wider tiles amortize them
     while the (2L, TB) accumulator still fits VMEM easily (L=64, TB=512:
-    ~0.3 MB). Values are the measured winners of a DDS_PROD_TB sweep
+    ~0.3 MB). L=256 (128 lanes) is the r3-measured winner; the small-L
+    values are VMEM-fit picks pending the on-chip DDS_PROD_TB sweep
     (e.g. `DDS_PROD_TB=512 python -m benchmarks.product --sizes 1024`).
     CAUTION: DDS_PROD_TB is read at TRACE time and the callers' jit/lru
     caches key on shapes only — sweep with ONE PROCESS PER VALUE, never

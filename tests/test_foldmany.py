@@ -43,3 +43,19 @@ def test_backend_fold_many_dispatches_kernel_family():
     folds = [[rng.randrange(1, n) for _ in range(4)] for _ in range(2)]
     be = TpuBackend(pallas=True, kernel="v2", min_device_batch=0)
     assert be.modmul_fold_many(folds, n) == [_want(f, n) for f in folds]
+
+
+def test_fold_many_fuzz_against_int():
+    """Randomized shapes: R in 1..6 requests, widths 1..70, two moduli
+    sizes, both kernels — every segment's product must match python ints
+    (guards the elem-major layout + per-request R-power accounting)."""
+    for trial in range(6):
+        bits = 256 if trial % 2 else 384
+        n = rng.getrandbits(bits) | (1 << (bits - 1)) | 1
+        folds = [
+            [rng.randrange(1, n) for _ in range(rng.randint(1, 70))]
+            for _ in range(rng.randint(1, 6))
+        ]
+        kernel = "v2" if trial % 3 == 0 else "jnp"
+        got = foldmany.fold_many(folds, n, kernel=kernel)
+        assert got == [_want(f, n) for f in folds], (trial, kernel)
