@@ -115,6 +115,15 @@ class ProxyConfig:
     ssl_client_context: object = None
 
 
+async def _cancel_task(task: asyncio.Task) -> None:
+    """Cancel a background task and swallow its CancelledError."""
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
+
+
 class DDSRestServer:
     def __init__(self, abd: AbdClient, config: ProxyConfig | None = None):
         self.abd = abd
@@ -174,11 +183,7 @@ class DDSRestServer:
         if self._fold_drainer is not None and not self._fold_drainer.done():
             # resolve queued folds before teardown so no request future is
             # orphaned and no task outlives the server
-            self._fold_drainer.cancel()
-            try:
-                await self._fold_drainer
-            except asyncio.CancelledError:
-                pass
+            await _cancel_task(self._fold_drainer)
             err = ConnectionError("proxy stopping")
             for _, group in self._fold_pending.items():
                 for _, fut in group:
@@ -187,11 +192,7 @@ class DDSRestServer:
             self._fold_pending.clear()
             self._fold_drainer = None
         if self._keys_saver is not None:
-            self._keys_saver.cancel()
-            try:
-                await self._keys_saver
-            except asyncio.CancelledError:
-                pass
+            await _cancel_task(self._keys_saver)
             self._keys_saver = None
         if self._keys_dirty:
             self._write_keys_snapshot()  # flush pending mutations on shutdown
@@ -811,6 +812,13 @@ class DDSRestServer:
                 result *= o
         return Response.json(J.value_result(str(result)))
 
+    def _backend_fold_fn(self):
+        """The backend's single-aggregate fold entry point (the
+        device-store-aware variant when the backend has one)."""
+        return getattr(
+            self.backend, "modmul_fold_resident", self.backend.modmul_fold
+        )
+
     async def _fold(self, operands: list[int], modulus: int):
         """Dispatch one aggregate's fold: wide folds go straight to the
         backend on a worker thread; small folds (below the device-batch
@@ -822,7 +830,7 @@ class DDSRestServer:
         executing or queued — observed concurrency is the signal there is
         something to coalesce with; a lone request pays zero extra latency."""
         be = self.backend
-        fold = getattr(be, "modmul_fold_resident", be.modmul_fold)
+        fold = self._backend_fold_fn()
         min_batch = getattr(be, "min_device_batch", 0)
         concurrent = self._folds_inflight > 0 or bool(self._fold_pending)
         if (
@@ -870,10 +878,7 @@ class DDSRestServer:
                 # worker thread per fold (not one serial loop): native
                 # host folds release the GIL, so group members overlap
                 # exactly as they would have without the window
-                fold = getattr(
-                    self.backend, "modmul_fold_resident",
-                    self.backend.modmul_fold,
-                )
+                fold = self._backend_fold_fn()
                 results = await asyncio.gather(
                     *(asyncio.to_thread(fold, f, modulus) for f in folds)
                 )

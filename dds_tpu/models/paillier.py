@@ -196,8 +196,6 @@ class PaillierKey:
         qinv = pow(q, -1, p)
         return hp, hq, qinv
 
-    def _crt_params(self):
-        return self._crt
 
     def decrypt(self, c: int) -> int:
         # the batch-of-one host path IS the per-op CRT decrypt; one body
@@ -215,7 +213,7 @@ class PaillierKey:
         89-101`). Below `min_batch`, or with no backend, the per-op host
         path."""
         p, q, n = self.p, self.q, self.n
-        hp, hq, qinv = self._crt_params()
+        hp, hq, qinv = self._crt
         p2, q2 = p * p, q * q
         cps = [c % p2 for c in cs]
         cqs = [c % q2 for c in cs]
