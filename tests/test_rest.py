@@ -584,3 +584,61 @@ def test_concurrent_small_sumalls_coalesce_into_one_dispatch():
             assert calls["single"] == before["single"] + 3
 
     asyncio.run(go())
+
+
+def test_coalesced_dispatch_failure_fails_all_waiters_cleanly():
+    """A failing coalesced device dispatch must surface as 500s to every
+    waiting request (never a hang) and leave the coalescer reusable for
+    the next, healthy, burst."""
+    from dds_tpu.models.backend import TpuBackend
+
+    async def go():
+        async with rest_stack() as (server, _, _):
+            be = TpuBackend(pallas=False, min_device_batch=10)
+            boom = {"on": True}
+            orig_many = be.modmul_fold_many
+            orig_resident = be.modmul_fold_resident
+
+            def maybe_boom(folds, mod):
+                if boom["on"]:
+                    raise RuntimeError("device fell off")
+                return orig_many(folds, mod)
+
+            def slow_host(cs, mod):
+                # hold the concurrency signal open so the rest of the burst
+                # deterministically piles into the coalescing window
+                import time as _time
+
+                _time.sleep(0.05)
+                return orig_resident(cs, mod)
+
+            be.modmul_fold_many = maybe_boom
+            be.modmul_fold_resident = slow_host
+            server.backend = be
+            pk = KEYS.psse.public
+            vals = [2, 3, 5, 7, 11, 13]
+            for v in vals:
+                await call(server, "POST", "/PutSet", {"contents": [str(pk.encrypt(v))]})
+
+            target = f"/SumAll?position=0&nsqr={pk.nsquare}"
+            results = await asyncio.wait_for(
+                asyncio.gather(*(call(server, "GET", target) for _ in range(5))),
+                timeout=15,
+            )
+            statuses = sorted(st for st, _ in results)
+            # the first (host-path) request succeeds; the coalesced group
+            # all get the failure as 500s — nobody hangs
+            assert statuses[0] == 200 and statuses[-1] == 500
+            assert statuses.count(500) >= 1
+
+            # coalescer recovers once the backend is healthy again
+            boom["on"] = False
+            results = await asyncio.wait_for(
+                asyncio.gather(*(call(server, "GET", target) for _ in range(5))),
+                timeout=15,
+            )
+            for st, data in results:
+                assert st == 200
+                assert KEYS.psse.decrypt(int(json.loads(data)["result"])) == sum(vals)
+
+    asyncio.run(go())
