@@ -1,0 +1,50 @@
+#!/bin/bash
+# Round-5 TPU measurement backlog — run when the tunneled chip is back.
+# One job at a time (the tunnel is single-tenant); generous timeouts
+# (first compiles 20-40 s/shape); everything appends to backlog_results/.
+# Usage: bash benchmarks/tpu_backlog.sh   (from /root/repo)
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/backlog_results
+mkdir -p "$OUT"
+
+run() { # name, timeout_s, cmd...
+  local name=$1 t=$2; shift 2
+  echo "=== $name ==="
+  timeout "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  echo "rc=$? ($name)"
+}
+
+# 0) probe gate: refuse to start while wedged
+if ! timeout 90 python -u -c "import jax; assert jax.default_backend() in ('tpu','axon'), jax.default_backend(); print('tpu ok')"; then
+  echo "tunnel still wedged; aborting backlog" >&2
+  exit 1
+fi
+
+# 1) fold crossover curve (device columns; justifies min_device_batch)
+run crossover 1800 python -m benchmarks.crossover
+
+# 2) encrypt-grade 2048-bit-exponent modexp + batched CRT decrypt
+run encrypt_modexp 2400 python -m benchmarks.encrypt_modexp
+
+# 3) kernel families incl. the fused Karatsuba (v1 / v2 / v2-kfused)
+run kernel_compare 2400 python -m benchmarks.kernel_compare
+
+# 4) roofline report (v2 ns/modmul vs compute floor per key size)
+run profile_kernel 1800 python -m benchmarks.profile_kernel
+
+# 5) DDS_PROD_TB sweep for RSA-1024 (ONE PROCESS PER VALUE — trace-time env)
+for tb in 128 256 512 1024; do
+  run "product_tb$tb" 1200 env DDS_PROD_TB=$tb python -m benchmarks.product --sizes 1024
+done
+
+# 6) config 5 re-spec (YCSB load phase + concurrent clients)
+run mixed_respec 3600 python -m benchmarks.mixed --preload 4096 --clients 4
+
+# 7) concurrent-client writes with the device bulk-encrypt path
+run put_bulk_tpu 2400 python -m benchmarks.put_concurrency --bulk tpu --clients 1 4
+
+# 8) the headline (also refreshes results for BENCH_rN)
+run bench 3600 python bench.py
+
+echo "backlog complete; results in $OUT/"
