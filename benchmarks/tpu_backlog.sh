@@ -33,9 +33,11 @@ run kernel_compare 2400 python -m benchmarks.kernel_compare
 # 4) roofline report (v2 ns/modmul vs compute floor per key size)
 run profile_kernel 1800 python -m benchmarks.profile_kernel
 
-# 5) DDS_PROD_TB sweep for RSA-1024 (ONE PROCESS PER VALUE — trace-time env)
+# 5) DDS_PROD_TB sweep for the small-limb sizes (ONE PROCESS PER VALUE —
+# the env is read at trace time). Covers both L=64 (RSA-1024) and L=128
+# (RSA-2048), whose _tb_for defaults changed pending this measurement.
 for tb in 128 256 512 1024; do
-  run "product_tb$tb" 1200 env DDS_PROD_TB=$tb python -m benchmarks.product --sizes 1024
+  run "product_tb$tb" 1800 env DDS_PROD_TB=$tb python -m benchmarks.product --sizes 1024,2048
 done
 
 # 6) config 5 re-spec (YCSB load phase + concurrent clients)
