@@ -94,6 +94,13 @@ class TpuBackend:
             raise ValueError(
                 f"unknown fold kernel {self.kernel!r} (must be v1 or v2)"
             )
+        if self.pallas and self.kernel == "v2":
+            # surface a bogus DDS_KARATSUBA at construction, not deep
+            # inside the first traced fold; only v2 consults it, and
+            # ops.flags is jax-free (no pallas import on this path)
+            from dds_tpu.ops.flags import karatsuba_mode
+
+            karatsuba_mode()
         # Adaptive dispatch: below this fold width the flat device-dispatch
         # latency loses to a host fold, so small aggregates stay on host
         # (measured crossover ~1024 on tunneled v5e; DDS_TPU_MIN_BATCH

@@ -384,24 +384,13 @@ def prod_lm_k1(a, b, TB: int | None = None, interpret: bool | None = None):
 
 
 def _use_karatsuba() -> str | bool:
-    """DDS_KARATSUBA: "" / 0 -> off (plain schoolbook, the measured
-    default), 1 -> the composed k1 variant (XLA-side combine; kept as the
-    negative-result record), 2 / "fused" -> the fully in-kernel variant
-    (_make_kfused_kernel). Returns a mode usable as a jit cache key."""
-    import os
+    """DDS_KARATSUBA mode (see ops/flags.karatsuba_mode — jax-free so
+    validators need not import this module): False = plain schoolbook
+    (the measured default), "k1" = composed variant, "fused" = the fully
+    in-kernel variant (_make_kfused_kernel)."""
+    from dds_tpu.ops.flags import karatsuba_mode
 
-    flag = os.environ.get("DDS_KARATSUBA", "").strip().lower()
-    if not flag or flag in ("0", "false", "off", "no"):
-        return False
-    if flag in ("2", "fused"):
-        return "fused"
-    if flag in ("1", "true", "on", "yes", "k1"):
-        return "k1"
-    # a typo ("kfused", "3") silently running the recorded-negative k1
-    # variant would mislead every number downstream — fail loudly
-    raise ValueError(
-        f"unknown DDS_KARATSUBA value {flag!r} (use 0, 1/k1, or 2/fused)"
-    )
+    return karatsuba_mode()
 
 
 # ---------------------------------------------------------------------------
