@@ -5,9 +5,14 @@ proxy (`dds/http/DDSRestServer.scala:952-1000, 1002-1050`): pick a random
 trusted replica as coordinator, send a signed `Envelope(IRead/IWrite)`,
 await the enveloped reply, and verify (a) the challenge nonce is the request
 nonce + increment, (b) the proxy HMAC over the reply, (c) the echoed key.
-Every failure increments local suspicion on the coordinator (3 strikes
-excludes it — `utils/TrustedNodesList.scala:23-29`) and raises a typed
-Byzantine exception.
+Every protocol violation increments local suspicion on the coordinator
+(3 strikes excludes it permanently — `utils/TrustedNodesList.scala:23-29`)
+and raises a typed Byzantine exception; mere timeouts instead trip a
+per-coordinator circuit breaker (utils/retry.CircuitBreaker) that steers
+the next picks elsewhere and self-heals via half-open probes, so replicas
+cut off by a (healed) partition regain coordination without a restart.
+Callers may pass a `Deadline` so each attempt's timeout shrinks to the
+remaining request budget instead of a fixed 5 s per layer.
 
 Reply correlation mirrors Akka ask semantics: a junk reply from the asked
 coordinator (wrong shape, bare message) resolves the outstanding request and
@@ -19,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import logging
 from dataclasses import dataclass
+from typing import Optional
 
 from dds_tpu.core import messages as M
 from dds_tpu.core.errors import (
@@ -28,6 +34,7 @@ from dds_tpu.core.errors import (
     ByzUnknownReplyError,
 )
 from dds_tpu.core.transport import Transport
+from dds_tpu.utils.retry import CircuitBreaker, Deadline, DeadlineExceededError
 from dds_tpu.utils.trace import tracer
 from dds_tpu.utils import sigs
 from dds_tpu.utils.trust import TrustedNodesList
@@ -51,6 +58,13 @@ class AbdClientConfig:
     # `dds-system.conf:94` puts both secrets in the one shared config)
     abd_mac_secret: bytes = b"intranet-abd-secret"
     quorum_size: int = 5
+    # per-coordinator circuit breaker (utils/retry.CircuitBreaker): transient
+    # unreachability (ask timeouts) trips it and self-heals via half-open
+    # probes, while cryptographic protocol violations ALSO land on the
+    # permanent 3-strike suspicion counter. Splitting the two is what lets
+    # a healed partition serve again without a proxy restart.
+    breaker_threshold: int = 3
+    breaker_reset: float = 2.0
 
 
 class AbdClient:
@@ -65,6 +79,8 @@ class AbdClient:
         self.net = net
         self.cfg = config or AbdClientConfig()
         self.replicas = TrustedNodesList(replicas)
+        # coordinator addr -> CircuitBreaker (created on first failure path)
+        self.breakers: dict[str, CircuitBreaker] = {}
         # challenge nonce -> (future, coordinator)
         self._pending: dict[int, tuple[asyncio.Future, str]] = {}
         self._preferred: list[str] = []  # supervisor's freshest-half view
@@ -102,92 +118,144 @@ class AbdClient:
                 return
         log.debug("unmatched message from %s: %s", sender, type(msg).__name__)
 
-    async def _ask(self, call, nonce: int, signature: bytes, exclude=()):
-        coordinator = self.replicas.defer_to(exclude, prefer=self._preferred)
+    def _breaker(self, node: str) -> CircuitBreaker:
+        b = self.breakers.get(node)
+        if b is None:
+            b = self.breakers[node] = CircuitBreaker(
+                self.cfg.breaker_threshold, self.cfg.breaker_reset
+            )
+        return b
+
+    def breaker_states(self) -> dict[str, str]:
+        """Current breaker state per coordinator (for the /health route)."""
+        return {n: b.state for n, b in sorted(self.breakers.items())}
+
+    def _coord_failed(self, coord: str) -> None:
+        """A coordinator answered with a PROTOCOL VIOLATION: permanent
+        suspicion strike (cryptographic evidence, never decays) plus a
+        breaker failure (steers the next pick away immediately)."""
+        self.replicas.increment_suspicion(coord)
+        self._breaker(coord).record_failure()
+
+    def _attempt_timeout(self, deadline: Optional[Deadline]) -> float:
+        """Per-attempt timeout, clipped to the caller's remaining budget."""
+        if deadline is None:
+            return self.cfg.request_timeout
+        timeout = deadline.timeout(self.cfg.request_timeout)
+        if timeout <= 0:
+            raise DeadlineExceededError(
+                f"no budget left for a quorum attempt ({deadline!r})",
+                elapsed=deadline.elapsed(),
+            )
+        return timeout
+
+    async def _ask(self, call, nonce: int, signature: bytes, exclude=(),
+                   deadline: Optional[Deadline] = None):
+        # route around open breakers; defer_to falls back to the full
+        # trusted set when everything is excluded (a degraded try beats
+        # instant failure, and a success closes the breaker again)
+        blocked = tuple(n for n, b in self.breakers.items() if not b.allow())
+        timeout = self._attempt_timeout(deadline)
+        coordinator = self.replicas.defer_to(
+            tuple(exclude) + blocked, prefer=self._preferred
+        )
         challenge = nonce + self.cfg.nonce_increment
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[challenge] = (fut, coordinator)
         try:
             self.net.send(self.addr, coordinator, M.Envelope(call, nonce, signature))
             try:
-                reply = await asyncio.wait_for(fut, self.cfg.request_timeout)
+                reply = await asyncio.wait_for(fut, timeout)
             except asyncio.TimeoutError:
-                self.replicas.increment_suspicion(coordinator)
+                # transient unreachability: breaker only — the permanent
+                # suspicion counter is reserved for protocol violations, so
+                # a healed partition's replicas regain coordination without
+                # a restart (deviation from the reference, which struck on
+                # every timeout and could never un-strike)
+                self._breaker(coordinator).record_failure()
                 raise
             return reply, coordinator, challenge
         finally:
             self._pending.pop(challenge, None)
 
-    async def fetch_set(self, key: str):
+    async def fetch_set(self, key: str, deadline: Optional[Deadline] = None):
         """Quorum read; returns the stored set (list) or None."""
-        return (await self.fetch_set_tagged(key))[0]
+        return (await self.fetch_set_tagged(key, deadline=deadline))[0]
 
-    async def fetch_set_tagged(self, key: str):
+    async def fetch_set_tagged(self, key: str, deadline: Optional[Deadline] = None):
         """Quorum read; returns (set|None, tag) — the tag of the value the
         coordinator wrote back, for tag-validated caching."""
-        value, tag, _ = await self.fetch_set_attributed(key)
+        value, tag, _ = await self.fetch_set_attributed(key, deadline=deadline)
         return value, tag
 
-    async def fetch_set_attributed(self, key: str, exclude=()):
+    async def fetch_set_attributed(self, key: str, exclude=(),
+                                   deadline: Optional[Deadline] = None):
         """Quorum read; returns (set|None, tag, coordinator). `exclude`
         steers coordinator choice away from given nodes so an audit's
         corroborating re-read goes through a different coordinator than
-        the read it is checking."""
+        the read it is checking. `deadline` clips the attempt to the
+        caller's remaining budget."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce)
         with tracer.span("abd.fetch"):
             reply, coord, challenge = await self._ask(
-                M.IRead(key), nonce, sig, exclude
+                M.IRead(key), nonce, sig, exclude, deadline
             )
 
         cfg = self.cfg
         match reply:
             case M.Envelope(M.IReadReply(k, value, tag), rnonce, rsig):
                 if rnonce != challenge:
-                    self.replicas.increment_suspicion(coord)
+                    self._coord_failed(coord)
                     raise ByzFailedNonceChallengeError(coord)
                 if not sigs.validate_proxy_signature(
                     cfg.proxy_mac_secret, k, rnonce, rsig,
                     [value, sigs.tag_payload(tag)],
                 ):
-                    self.replicas.increment_suspicion(coord)
+                    self._coord_failed(coord)
                     raise ByzInvalidSignatureError(coord)
                 if k != key:
-                    self.replicas.increment_suspicion(coord)
+                    self._coord_failed(coord)
                     raise ByzInvalidKeyError(coord)
+                self._breaker(coord).record_success()
                 return value, tag, coord
             case _:
-                self.replicas.increment_suspicion(coord)
+                self._coord_failed(coord)
                 raise ByzUnknownReplyError(coord)
 
-    async def write_set(self, key: str, value) -> str:
+    async def write_set(self, key: str, value,
+                        deadline: Optional[Deadline] = None) -> str:
         """Quorum write (value=None removes); returns the key on success."""
-        return (await self.write_set_tagged(key, value))[0]
+        return (await self.write_set_tagged(key, value, deadline=deadline))[0]
 
-    async def write_set_tagged(self, key: str, value):
+    async def write_set_tagged(self, key: str, value,
+                               deadline: Optional[Deadline] = None):
         """Quorum write; returns (key, tag) where tag is the tag written."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce, value)
         with tracer.span("abd.write"):
-            reply, coord, challenge = await self._ask(M.IWrite(key, value), nonce, sig)
+            reply, coord, challenge = await self._ask(
+                M.IWrite(key, value), nonce, sig, (), deadline
+            )
 
         cfg = self.cfg
         match reply:
             case M.Envelope(M.IWriteReply(k, tag), rnonce, rsig):
                 if rnonce != challenge:
-                    self.replicas.increment_suspicion(coord)
+                    self._coord_failed(coord)
                     raise ByzFailedNonceChallengeError(coord)
                 if not sigs.validate_proxy_signature(
                     cfg.proxy_mac_secret, k, rnonce, rsig, sigs.tag_payload(tag)
                 ):
-                    self.replicas.increment_suspicion(coord)
+                    self._coord_failed(coord)
                     raise ByzInvalidSignatureError(coord)
                 if k != key:
-                    self.replicas.increment_suspicion(coord)
+                    self._coord_failed(coord)
                     raise ByzInvalidKeyError(coord)
+                self._breaker(coord).record_success()
                 return k, tag
             case _:
-                self.replicas.increment_suspicion(coord)
+                self._coord_failed(coord)
                 raise ByzUnknownReplyError(coord)
 
     def _on_tag_batch_reply(self, sender: str, msg: M.TagBatchReply) -> None:
@@ -230,6 +298,7 @@ class AbdClient:
         digest: str | None = None,
         fingerprint: bytes | None = None,
         cached_tags: list | None = None,
+        deadline: Optional[Deadline] = None,
     ) -> list[M.ABDTag]:
         """Batched freshness probe: the quorum-max tag per key via ONE
         tag-only round broadcast by the proxy ITSELF — `ReadTagBatch` fans
@@ -269,6 +338,7 @@ class AbdClient:
             )
         if fingerprint is not None and cached_tags is None:
             raise ValueError("fingerprint requires cached_tags")
+        timeout = self._attempt_timeout(deadline)
         nonce = sigs.generate_nonce()
         if digest is None:
             digest = sigs.key_from_set(list(keys))
@@ -280,7 +350,7 @@ class AbdClient:
                 req = M.ReadTagBatch(tuple(keys), nonce, sig, fingerprint)
                 for replica in trusted:
                     self.net.send(self.addr, replica, req)
-                vectors = await asyncio.wait_for(fut, self.cfg.request_timeout)
+                vectors = await asyncio.wait_for(fut, timeout)
             if not keys:
                 return []
             if all(v is _UNCHANGED for v in vectors):

@@ -50,16 +50,24 @@ class Response:
 _REASONS = {
     200: "OK", 204: "No Content", 400: "Bad Request", 404: "Not Found",
     405: "Method Not Allowed", 500: "Internal Server Error",
+    503: "Service Unavailable",
 }
 
 Handler = Callable[[Request], Awaitable[Response]]
 
 
 class HttpServer:
-    def __init__(self, host: str, port: int, handler: Handler, ssl_context=None):
+    """`handler_timeout` (seconds, 0 = off) is the transport-level backstop
+    of the deadline story: a handler that somehow outlives the REST layer's
+    own budget is cancelled and the client gets 503 + Retry-After instead
+    of a silently wedged connection."""
+
+    def __init__(self, host: str, port: int, handler: Handler, ssl_context=None,
+                 handler_timeout: float = 0.0):
         self.host, self.port = host, port
         self.handler = handler
         self.ssl_context = ssl_context
+        self.handler_timeout = handler_timeout
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
@@ -112,7 +120,17 @@ class HttpServer:
                     body=body,
                 )
                 try:
-                    resp = await self.handler(req)
+                    if self.handler_timeout > 0:
+                        resp = await asyncio.wait_for(
+                            self.handler(req), self.handler_timeout
+                        )
+                    else:
+                        resp = await self.handler(req)
+                except asyncio.TimeoutError:
+                    resp = Response(
+                        503, b"handler timed out",
+                        headers={"Retry-After": "1"},
+                    )
                 except Exception:
                     import logging
 
@@ -147,6 +165,24 @@ async def http_request(
     timeout: float = 30.0,
 ) -> tuple[int, bytes]:
     """One-shot HTTP client request; returns (status, body)."""
+    status, _, data = await http_request_full(
+        host, port, method, target, body, content_type, ssl_context, timeout
+    )
+    return status, data
+
+
+async def http_request_full(
+    host: str,
+    port: int,
+    method: str,
+    target: str,
+    body: bytes | None = None,
+    content_type: str = "application/json",
+    ssl_context=None,
+    timeout: float = 30.0,
+) -> tuple[int, dict, bytes]:
+    """Like `http_request` but also returns the (lower-cased) response
+    headers — callers inspecting Retry-After / degradation metadata."""
 
     async def go():
         reader, writer = await asyncio.open_connection(host, port, ssl=ssl_context)
@@ -177,7 +213,7 @@ async def http_request(
                 data = await reader.readexactly(int(headers["content-length"]))
             else:
                 data = await reader.read()
-            return status, data
+            return status, headers, data
         finally:
             writer.close()
 

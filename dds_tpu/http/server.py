@@ -27,28 +27,63 @@ Reference quirks deliberately FIXED (SURVEY.md §7 "replicate or fix"):
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 import ssl
 from dataclasses import dataclass, field
 from typing import Optional
 
+from dds_tpu.core.errors import ByzantineError
 from dds_tpu.core.quorum_client import AbdClient
 from dds_tpu.http import json_protocol as J
 from dds_tpu.http.miniserver import HttpServer, Request, Response, http_request
 from dds_tpu.models.backend import CryptoBackend, get_backend
 from dds_tpu.utils import sigs
-from dds_tpu.utils.retry import retry
+from dds_tpu.utils.retry import (
+    Deadline,
+    DeadlineExceededError,
+    RetryPolicy,
+    retry_deadline,
+)
 from dds_tpu.utils.trace import tracer
+from dds_tpu.utils.trust import NoTrustedNodesError
 
 log = logging.getLogger("dds.rest")
+
+# The per-request time budget, minted once in handle() and read by every
+# nested storage helper (_fetch/_write/_fetch_stored and their audits) —
+# deadline PROPAGATION without threading a parameter through 23 routes.
+_REQ_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
+    "dds_request_deadline", default=None
+)
+
+# transient storage-layer failures worth retrying; anything else (a
+# programming error, a bad request) propagates immediately
+_RETRYABLE = (ByzantineError, asyncio.TimeoutError, NoTrustedNodesError, OSError)
 
 
 @dataclass
 class ProxyConfig:
     host: str = "127.0.0.1"
     port: int = 8443
+    # Deadline-propagated retry (utils/retry): every request gets ONE
+    # overall budget minted at the REST edge; quorum attempts + exponential
+    # full-jitter backoffs retry inside it, per-attempt timeouts shrink to
+    # the remainder, and exhaustion degrades to 503 + Retry-After instead
+    # of hanging. retry_backoff is the backoff BASE; retry_attempts > 0
+    # restores a hard attempt cap on top (0 = deadline-governed, the
+    # chaos-tolerant default).
+    request_budget: float = 8.0
     retry_backoff: float = 0.3
-    retry_attempts: int = 2
+    retry_max_delay: float = 2.0
+    retry_attempts: int = 0
+    # seconds clients should wait before retrying after a 503 (the
+    # Retry-After header on every degraded response)
+    retry_after_hint: float = 1.0
+    # miniserver backstop (0 = off): cancels handlers that somehow outlive
+    # the budget — OFF by default because ciphertext compute (device folds,
+    # cold compiles) legitimately runs past the STORAGE budget
+    handler_timeout: float = 0.0
     crypto_backend: str = "cpu"
     # tag-validated aggregate cache (see _fetch_stored): one batched
     # tag-only quorum round validates all cached sets per aggregate instead
@@ -147,7 +182,8 @@ class DDSRestServer:
         self._pairs_memo: tuple | None = None  # state -> [(key, value)] result
         self._operand_memo: tuple | None = None  # pairs identity -> operands
         self._http = HttpServer(
-            self.cfg.host, self.cfg.port, self.handle, self.cfg.ssl_server_context
+            self.cfg.host, self.cfg.port, self.handle, self.cfg.ssl_server_context,
+            handler_timeout=self.cfg.handler_timeout,
         )
         self._tasks: list[asyncio.Task] = []
         self._keys_dirty = False
@@ -251,7 +287,10 @@ class DDSRestServer:
         async def _saver():
             while self._keys_dirty:
                 await asyncio.sleep(0.2)
-                self._write_keys_snapshot()
+                # off-loop: a large stored_keys set must not stall request
+                # handling during the write (stop() keeps the synchronous
+                # call — the loop is tearing down anyway)
+                await asyncio.to_thread(self._write_keys_snapshot)
 
         self._keys_saver = asyncio.ensure_future(_saver())
 
@@ -321,6 +360,25 @@ class DDSRestServer:
 
     # ----------------------------------------------------------- ABD access
 
+    def _request_deadline(self) -> Deadline:
+        """The current request's budget; helpers invoked outside a request
+        context (tests, background tasks) get a fresh full budget."""
+        dl = _REQ_DEADLINE.get()
+        return dl if dl is not None else Deadline(self.cfg.request_budget)
+
+    def _retry_policy(self) -> RetryPolicy:
+        attempts = self.cfg.retry_attempts
+        return RetryPolicy(
+            base=self.cfg.retry_backoff,
+            max_delay=self.cfg.retry_max_delay,
+            max_attempts=(attempts + 1) if attempts > 0 else None,
+        )
+
+    async def _retry(self, f, deadline: Deadline):
+        return await retry_deadline(
+            f, deadline, self._retry_policy(), retry_on=_RETRYABLE
+        )
+
     def _cache_put(self, key: str, tag, value) -> None:
         """Remember a completed op's (tag, value); newest tag wins (two
         interleaved ops on one key may resolve out of order here)."""
@@ -357,10 +415,9 @@ class DDSRestServer:
         return self._agg_memo
 
     async def _fetch_tagged(self, key: str, exclude=()):
-        value, tag, coord = await retry(
-            lambda: self.abd.fetch_set_attributed(key, exclude),
-            self.cfg.retry_backoff,
-            self.cfg.retry_attempts,
+        dl = self._request_deadline()
+        value, tag, coord = await self._retry(
+            lambda: self.abd.fetch_set_attributed(key, exclude, deadline=dl), dl
         )
         self._cache_put(key, tag, value)
         return value, tag, coord
@@ -369,10 +426,9 @@ class DDSRestServer:
         return (await self._fetch_tagged(key))[0]
 
     async def _write(self, key: str, value):
-        k, tag = await retry(
-            lambda: self.abd.write_set_tagged(key, value),
-            self.cfg.retry_backoff,
-            self.cfg.retry_attempts,
+        dl = self._request_deadline()
+        k, tag = await self._retry(
+            lambda: self.abd.write_set_tagged(key, value, deadline=dl), dl
         )
         self._cache_put(key, tag, value)
         return k
@@ -415,13 +471,13 @@ class DDSRestServer:
         fresh_tags: dict[str, object] = {}
         if self.cfg.aggregate_cache and cached:
             try:
-                tags = await retry(
+                dl = self._request_deadline()
+                tags = await self._retry(
                     lambda: self.abd.read_tags(
                         cached, digest=digest, fingerprint=fp,
-                        cached_tags=cached_tags,
+                        cached_tags=cached_tags, deadline=dl,
                     ),
-                    self.cfg.retry_backoff,
-                    self.cfg.retry_attempts,
+                    dl,
                 )
                 if tags is cached_tags:
                     # identity return: every quorum vote said "unchanged",
@@ -566,14 +622,35 @@ class DDSRestServer:
 
     async def handle(self, req: Request) -> Response:
         route = req.path.split("/", 2)[1] if "/" in req.path else req.path
+        # ONE budget per request: every storage helper below reads it from
+        # the context var, so nested retries and per-attempt timeouts all
+        # shrink toward the same edge deadline
+        token = _REQ_DEADLINE.set(Deadline(self.cfg.request_budget))
         try:
             with tracer.span(f"http.{req.method}.{route or 'root'}"):
                 return await self._route(req)
         except (ValueError, KeyError, TypeError) as e:
             return Response.text(f"bad request: {e}", 400)
+        except (DeadlineExceededError, NoTrustedNodesError) as e:
+            # graceful degradation: the quorum is unreachable within the
+            # budget — tell the client WHEN to come back instead of hanging
+            # or aborting opaquely
+            log.warning("degraded %s %s: %s", req.method, req.path, e)
+            return self._unavailable(str(e))
         except Exception:
             log.exception("route failure %s %s", req.method, req.path)
             return Response(500)
+        finally:
+            _REQ_DEADLINE.reset(token)
+
+    def _unavailable(self, why: str) -> Response:
+        import math
+
+        return Response(
+            503,
+            f"service unavailable: {why}".encode(),
+            headers={"Retry-After": str(max(1, math.ceil(self.cfg.retry_after_hint)))},
+        )
 
     async def _route(self, req: Request) -> Response:
         parts = [p for p in req.path.split("/") if p]
@@ -743,6 +820,38 @@ class DDSRestServer:
                 # any client the full record-key set (workload shape) — the
                 # same rationale that keeps /_trace off by default.
                 return Response.json(J.keys_result(sorted(self.stored_keys)))
+
+            case ("GET", "health"):
+                # liveness/degradation probe: active-replica view, quorum
+                # requirement, and per-coordinator breaker states. Always
+                # on — it reveals cluster health, not workload shape (the
+                # /_trace gating rationale does not apply).
+                trusted = self.abd.replicas.get_trusted()
+                breakers = self.abd.breaker_states()
+                # reachable = trusted minus nodes whose breaker refuses
+                # traffic right now (open, pre-half-open)
+                reachable = [
+                    n for n in trusted
+                    if n not in self.abd.breakers or self.abd.breakers[n].allow()
+                ]
+                degraded = len(reachable) < self.abd.cfg.quorum_size
+                resp = Response.json(
+                    {
+                        "status": "degraded" if degraded else "ok",
+                        "active_replicas": len(trusted),
+                        "reachable_replicas": len(reachable),
+                        "quorum_size": self.abd.cfg.quorum_size,
+                        "breakers": breakers,
+                        "stored_keys": len(self.stored_keys),
+                        "request_budget": self.cfg.request_budget,
+                    },
+                    status=503 if degraded else 200,
+                )
+                if degraded:
+                    resp.headers["Retry-After"] = str(
+                        max(1, round(self.cfg.retry_after_hint))
+                    )
+                return resp
 
             case ("GET", "_trace") if self.cfg.trace_route_enabled:
                 # live observability (SURVEY §5.5): per-span timing summary

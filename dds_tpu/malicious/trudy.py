@@ -1,11 +1,18 @@
-"""Trudy: crash / Byzantine fault injector.
+"""Trudy & Nemesis: process- and network-level fault injectors.
 
-Counterpart of `malicious/MaliciousAttack.scala` + `malicious/Trudy.scala`:
-the attack enum and parser, and an injector that either crashes up to
-`max_faults` random replicas (the reference's `PoisonPill` — here the
-replica endpoint is torn off the transport so it goes silent) or flips them
-to the `byzantine` behavior via the `Compromise` backdoor
-(`BFTABDNode.scala:380-381`).
+Trudy is the counterpart of `malicious/MaliciousAttack.scala` +
+`malicious/Trudy.scala`: the attack enum and parser, and an injector that
+either crashes up to `max_faults` random replicas (the reference's
+`PoisonPill` — here the replica endpoint is torn off the transport so it
+goes silent) or flips them to the `byzantine` behavior via the
+`Compromise` backdoor (`BFTABDNode.scala:380-381`).
+
+Nemesis extends Trudy with the NETWORK faults the reference never had —
+`partition`, `delay`, `flood`, and `heal` — driven through the same
+`trigger()` injection path as crash/byzantine so harnesses schedule any
+fault mix uniformly. Partition/delay/heal require the fabric to be a
+`ChaosNet` (core/chaos.py); flood works on any transport (it is just
+unauthenticated junk traffic the replicas must shed via their MAC layer).
 """
 
 from __future__ import annotations
@@ -15,6 +22,7 @@ import logging
 import random
 
 from dds_tpu.core import messages as M
+from dds_tpu.core.chaos import ChaosNet, LinkFaults
 from dds_tpu.core.transport import Transport
 
 log = logging.getLogger("dds.trudy")
@@ -23,6 +31,11 @@ log = logging.getLogger("dds.trudy")
 class AttackType(enum.Enum):
     CRASH = "crash"
     BYZANTINE = "byzantine"
+    # network-level attacks (Nemesis; partition/delay/heal need a ChaosNet)
+    PARTITION = "partition"
+    DELAY = "delay"
+    FLOOD = "flood"
+    HEAL = "heal"
 
 
 def parse_attack(name: str) -> AttackType:
@@ -30,7 +43,10 @@ def parse_attack(name: str) -> AttackType:
     try:
         return AttackType(name.strip().lower())
     except ValueError:
-        raise ValueError(f"unknown attack type {name!r} (crash|byzantine)")
+        raise ValueError(
+            f"unknown attack type {name!r} "
+            "(crash|byzantine|partition|delay|flood|heal)"
+        )
 
 
 class Trudy:
@@ -42,6 +58,11 @@ class Trudy:
         self.addr = addr  # routable src so attacks also ride a TCP fabric
         self._rng = rng or random.Random()
 
+    def _victims(self) -> list[str]:
+        return self._rng.sample(
+            self.replicas, min(self.max_faults, len(self.replicas))
+        )
+
     def trigger(self, attack: AttackType | str) -> list[str]:
         """Attack up to max_faults random replicas; returns the victims.
 
@@ -51,12 +72,96 @@ class Trudy:
         remoting ActorRefs (`Trudy.scala:14-32`)."""
         if isinstance(attack, str):
             attack = parse_attack(attack)
-        victims = self._rng.sample(self.replicas, min(self.max_faults, len(self.replicas)))
+        victims = self._victims()
         for v in victims:
             if attack is AttackType.CRASH:
                 log.info("Trudy crashes %s", v)
                 self.net.send(self.addr, v, M.Crash())
-            else:
+            elif attack is AttackType.BYZANTINE:
                 log.info("Trudy compromises %s", v)
                 self.net.send(self.addr, v, M.Compromise())
+            else:
+                raise ValueError(
+                    f"{attack.value!r} is a Nemesis attack — use Nemesis"
+                )
+        return victims
+
+
+class Nemesis(Trudy):
+    """Trudy plus network-level attacks on a ChaosNet fabric.
+
+    `partition` isolates the victims from the rest of the cluster
+    (symmetric, with timed heal when `partition_duration` is set);
+    `delay` injects fixed+jittered latency into every link toward the
+    victims; `flood` bursts junk Envelopes at the victims (shed by their
+    proxy-MAC validation — a load fault, not a correctness one); `heal`
+    lifts every partition and link fault Nemesis (or anyone) installed."""
+
+    def __init__(
+        self,
+        net: Transport,
+        replicas: list[str],
+        max_faults: int = 2,
+        rng: random.Random | None = None,
+        addr: str = "trudy",
+        delay: float = 0.02,
+        jitter: float = 0.02,
+        flood_messages: int = 25,
+        partition_duration: float | None = None,
+    ):
+        super().__init__(net, replicas, max_faults, rng, addr)
+        self.delay = delay
+        self.jitter = jitter
+        self.flood_messages = flood_messages
+        self.partition_duration = partition_duration
+        self.active_partitions = []
+
+    def _chaos(self) -> ChaosNet:
+        if not isinstance(self.net, ChaosNet):
+            raise TypeError(
+                "partition/delay/heal attacks need a ChaosNet fabric; "
+                f"got {type(self.net).__name__}"
+            )
+        return self.net
+
+    def trigger(self, attack: AttackType | str) -> list[str]:
+        if isinstance(attack, str):
+            attack = parse_attack(attack)
+        if attack in (AttackType.CRASH, AttackType.BYZANTINE):
+            return super().trigger(attack)
+        if attack is AttackType.HEAL:
+            log.info("Nemesis heals the network")
+            self._chaos().heal_all()
+            self.active_partitions.clear()
+            return []
+        victims = self._victims()
+        if attack is AttackType.PARTITION:
+            log.info("Nemesis partitions %s", victims)
+            self.active_partitions.append(
+                self._chaos().partition(
+                    victims, duration=self.partition_duration
+                )
+            )
+        elif attack is AttackType.DELAY:
+            log.info("Nemesis delays links to %s", victims)
+            chaos = self._chaos()
+            for v in victims:
+                chaos.set_dest(
+                    v.rsplit("/", 1)[-1],
+                    LinkFaults(delay=self.delay, jitter=self.jitter),
+                )
+        elif attack is AttackType.FLOOD:
+            log.info("Nemesis floods %s", victims)
+            for v in victims:
+                for _ in range(self.flood_messages):
+                    # junk under a garbage signature: replicas burn a MAC
+                    # check and drop it — pure load, no protocol effect
+                    self.net.send(
+                        self.addr, v,
+                        M.Envelope(
+                            M.IRead(f"flood-{self._rng.getrandbits(32):08x}"),
+                            self._rng.getrandbits(63),
+                            b"nemesis-junk",
+                        ),
+                    )
         return victims

@@ -8,11 +8,25 @@ from __future__ import annotations
 
 import base64
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+# `cryptography` is gated, not required at import: environments without it
+# can still run the whole BFT/REST/chaos stack — only the AES-backed string
+# schemes (det/rand/searchable) fail, loudly, at first USE.
+try:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    _CRYPTO_ERR = None
+except ModuleNotFoundError as _e:  # pragma: no cover - env-dependent
+    Cipher = algorithms = modes = None
+    _CRYPTO_ERR = _e
 
 
 def aes_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
     """AES-256-CTR keystream application (encrypt == decrypt)."""
+    if Cipher is None:
+        raise ModuleNotFoundError(
+            "the AES-backed schemes (CHE/RND/searchable) need the "
+            "'cryptography' package, which is not installed"
+        ) from _CRYPTO_ERR
     c = Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
     return c.update(data) + c.finalize()
 

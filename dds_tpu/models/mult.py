@@ -14,7 +14,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.asymmetric import rsa
+# gated: only key GENERATION at >= 1024 bits uses cryptography's fast RSA
+# keygen; without the package the local prime generator takes over
+try:
+    from cryptography.hazmat.primitives.asymmetric import rsa
+except ModuleNotFoundError:  # pragma: no cover - env-dependent
+    rsa = None
 
 from dds_tpu.native import powmod
 
@@ -46,7 +51,7 @@ class RsaMultKey:
     @staticmethod
     def generate(bits: int = 1024) -> "RsaMultKey":
         # Reference ships an RSA-1024 multiplicative key (client.conf:86).
-        if bits >= 1024:
+        if bits >= 1024 and rsa is not None:
             priv = rsa.generate_private_key(public_exponent=65537, key_size=bits)
             nums = priv.private_numbers()
             pub = nums.public_numbers

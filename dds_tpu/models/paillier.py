@@ -23,7 +23,12 @@ import secrets
 from dataclasses import dataclass
 from math import gcd
 
-from cryptography.hazmat.primitives.asymmetric import rsa
+# gated: only key GENERATION at >= 1024 bits rides cryptography's fast RSA
+# keygen; without the package the local prime generator takes over
+try:
+    from cryptography.hazmat.primitives.asymmetric import rsa
+except ModuleNotFoundError:  # pragma: no cover - env-dependent
+    rsa = None
 
 from dds_tpu.native import powmod
 
@@ -169,7 +174,7 @@ class PaillierKey:
 
     @staticmethod
     def generate(bits: int = 2048) -> "PaillierKey":
-        if bits >= 1024:
+        if bits >= 1024 and rsa is not None:
             # cryptography's RSA keygen produces two same-size primes fast;
             # we only use p and q (it refuses sizes below 1024).
             priv = rsa.generate_private_key(public_exponent=65537, key_size=bits)

@@ -24,3 +24,26 @@ def karatsuba_mode() -> str | bool:
     raise ValueError(
         f"unknown DDS_KARATSUBA value {flag!r} (use 0, 1/k1, or 2/fused)"
     )
+
+
+def prod_tb() -> int | None:
+    """DDS_PROD_TB: lane-tile override for the MXU product kernel, or None
+    when unset. Validated HERE — int, positive, multiple of the 128-lane
+    width — so a typo fails loudly at flag-read time with an actionable
+    message instead of an opaque ValueError (or a mis-shaped kernel) deep
+    inside a trace (mirrors karatsuba_mode's loud-validation policy)."""
+    env = os.environ.get("DDS_PROD_TB", "").strip()
+    if not env:
+        return None
+    try:
+        tb = int(env)
+    except ValueError:
+        raise ValueError(
+            f"DDS_PROD_TB must be an integer number of lanes, got {env!r}"
+        ) from None
+    if tb <= 0 or tb % 128:
+        raise ValueError(
+            f"DDS_PROD_TB must be a positive multiple of 128 (the TPU lane "
+            f"width), got {tb}"
+        )
+    return tb

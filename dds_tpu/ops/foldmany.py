@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dds_tpu.ops import bignum as bn
+from dds_tpu.ops.flags import karatsuba_mode
 from dds_tpu.ops.montgomery import ModCtx, _mont_mul_raw
 
 _FN_CACHE: dict = {}
@@ -60,11 +61,17 @@ def _mul_bm(ctx: ModCtx, kernel: str, interpret: bool):
 
 
 def _fold_many_fn(ctx: ModCtx, kernel: str, R: int):
-    key = (ctx.n, kernel, R)
+    # the karatsuba mode and interpret flag are captured at build time by
+    # _mul_bm, so they MUST be in the cache key (mirroring mont_mxu's
+    # per-call karatsuba keying) — otherwise flipping DDS_KARATSUBA or the
+    # backend mid-process would silently serve a stale compiled function
+    interpret = _interpret_default()
+    kmode = karatsuba_mode() if kernel == "v2" else None
+    key = (ctx.n, kernel, R, interpret, kmode)
     fn = _FN_CACHE.get(key)
     if fn is not None:
         return fn
-    mul = _mul_bm(ctx, kernel, _interpret_default())
+    mul = _mul_bm(ctx, kernel, interpret)
 
     def run(arr, fixes):
         # arr: (P2*R, L) elem-major plain-domain; fixes: (R, L) = R^K_r

@@ -66,11 +66,11 @@ def _tb_for(L: int) -> int:
     CAUTION: DDS_PROD_TB is read at TRACE time and the callers' jit/lru
     caches key on shapes only — sweep with ONE PROCESS PER VALUE, never
     by mutating the env mid-process (stale traces would be re-timed)."""
-    import os
+    from dds_tpu.ops.flags import prod_tb
 
-    env = os.environ.get("DDS_PROD_TB", "").strip()
-    if env:
-        return int(env)
+    env_tb = prod_tb()  # validated: int, > 0, multiple of 128 — loud errors
+    if env_tb is not None:
+        return env_tb
     if L <= 64:
         return 512
     if L <= 128:

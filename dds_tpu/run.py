@@ -143,6 +143,16 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
         def full(name: str) -> str:
             return name
 
+    if cfg.attacks.chaos_enabled:
+        # seeded fault fabric: every send traverses the ChaosNet schedule,
+        # and Nemesis (below) gains partition/delay/flood/heal attacks.
+        # The inner transport stays in `stoppables`; ChaosNet.stop only
+        # cancels its own deferred deliveries.
+        from dds_tpu.core.chaos import ChaosNet
+
+        net = ChaosNet(net, seed=cfg.attacks.chaos_seed)
+        stoppables.append(net)
+
     rcfg = ReplicaConfig(
         quorum_size=cfg.replicas.byz_quorum_size,
         nonce_increment=cfg.security.nonce_challenge_increment,
@@ -287,6 +297,8 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             request_timeout=cfg.proxy.intranet_request_timeout,
             abd_mac_secret=cfg.security.abd_mac_secret.encode(),
             quorum_size=cfg.replicas.byz_quorum_size,
+            breaker_threshold=cfg.proxy.breaker_threshold,
+            breaker_reset=cfg.proxy.breaker_reset,
         ),
     )
     server = DDSRestServer(
@@ -294,8 +306,12 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
         ProxyConfig(
             host=cfg.proxy.host,
             port=cfg.proxy.port,
+            request_budget=cfg.proxy.request_budget,
             retry_backoff=cfg.proxy.retry_backoff,
+            retry_max_delay=cfg.proxy.retry_max_delay,
             retry_attempts=cfg.proxy.retry_attempts,
+            retry_after_hint=cfg.proxy.retry_after_hint,
+            handler_timeout=cfg.proxy.handler_timeout,
             crypto_backend=cfg.proxy.crypto_backend,
             key_sync_enabled=cfg.proxy.key_sync_enabled,
             key_sync_warmup=cfg.proxy.key_sync_warm_up,
@@ -311,7 +327,14 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     )
     await server.start()
 
-    trudy = Trudy(net, active, cfg.replicas.byz_max_faults, addr=full("trudy"))
+    if cfg.attacks.chaos_enabled:
+        from dds_tpu.malicious.trudy import Nemesis
+
+        trudy = Nemesis(net, active, cfg.replicas.byz_max_faults,
+                        addr=full("trudy"))
+    else:
+        trudy = Trudy(net, active, cfg.replicas.byz_max_faults,
+                      addr=full("trudy"))
     dep = Deployment(cfg, net, replicas, supervisor, server, trudy, ssl_client,
                      stoppables)
 

@@ -91,8 +91,21 @@ class ProxySettings:
     port: int = 8443
     crypto_backend: str = "cpu"        # the BASELINE.json crypto.backend switch
     intranet_request_timeout: float = 5.0
-    retry_attempts: int = 2
+    # deadline-propagated retry (utils/retry; see http/server.ProxyConfig):
+    # one request_budget per REST request, exponential backoff + full
+    # jitter from retry_backoff up to retry_max_delay; retry_attempts > 0
+    # adds a hard attempt cap (0 = deadline-governed); exhaustion returns
+    # 503 with Retry-After = retry_after_hint seconds
+    request_budget: float = 8.0
+    retry_attempts: int = 0
     retry_backoff: float = 0.3
+    retry_max_delay: float = 2.0
+    retry_after_hint: float = 1.0
+    handler_timeout: float = 0.0       # miniserver backstop, 0 = off
+    # per-coordinator circuit breaker (transient-failure steering that
+    # self-heals after breaker_reset seconds via a half-open probe)
+    breaker_threshold: int = 3
+    breaker_reset: float = 2.0
     key_sync_enabled: bool = False
     key_sync_warm_up: float = 1.0
     key_sync_interval: float = 5.0
@@ -165,7 +178,14 @@ class ClientSettings:
 @dataclass
 class AttackConfig:
     enabled: bool = False
-    type: str = "byzantine"            # crash | byzantine
+    # crash | byzantine | partition | delay | flood | heal (the network
+    # attacks need chaos_enabled so a ChaosNet fabric exists to drive)
+    type: str = "byzantine"
+    # wrap the transport in a seeded ChaosNet (core/chaos.py) and use the
+    # Nemesis driver, so deployments can soak under deterministic network
+    # fault schedules; the seed reproduces the exact fault trace
+    chaos_enabled: bool = False
+    chaos_seed: int = 0
 
 
 @dataclass

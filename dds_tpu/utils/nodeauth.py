@@ -22,13 +22,31 @@ from __future__ import annotations
 
 import pathlib
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+# gated: only the Ed25519 key operations need `cryptography`; the helpers
+# below (write_secret_file) serve environments without it, and key users
+# fail loudly at first use rather than at import
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    _CRYPTO_ERR = None
+except ModuleNotFoundError as _e:  # pragma: no cover - env-dependent
+    Ed25519PrivateKey = Ed25519PublicKey = None
+    _CRYPTO_ERR = _e
+
+
+def _require_crypto() -> None:
+    if Ed25519PrivateKey is None:
+        raise ModuleNotFoundError(
+            "per-node transport identity needs the 'cryptography' package, "
+            "which is not installed"
+        ) from _CRYPTO_ERR
 
 
 def generate() -> Ed25519PrivateKey:
+    _require_crypto()
     return Ed25519PrivateKey.generate()
 
 
@@ -41,10 +59,12 @@ def public_hex(key: Ed25519PrivateKey) -> str:
 
 
 def load_private(hexstr: str) -> Ed25519PrivateKey:
+    _require_crypto()
     return Ed25519PrivateKey.from_private_bytes(bytes.fromhex(hexstr.strip()))
 
 
 def load_public(hexstr: str) -> Ed25519PublicKey:
+    _require_crypto()
     return Ed25519PublicKey.from_public_bytes(bytes.fromhex(hexstr.strip()))
 
 

@@ -11,6 +11,12 @@ import random
 STRIKE_LIMIT = 3
 
 
+class NoTrustedNodesError(RuntimeError):
+    """Every member is excluded (3-strike) — nothing left to coordinate a
+    quorum. Typed (but still a RuntimeError for old callers) so the REST
+    layer can degrade to 503 + Retry-After instead of a 500."""
+
+
 class TrustedNodesList:
     def __init__(self, nodes: list[str] | None = None, rng: random.Random | None = None):
         self._strikes: dict[str, int] = {n: 0 for n in (nodes or [])}
@@ -53,7 +59,7 @@ class TrustedNodesList:
         `DDSRestServer.scala:139-147`)."""
         trusted = self.get_trusted()
         if not trusted:
-            raise RuntimeError("no trusted nodes left")
+            raise NoTrustedNodesError("no trusted nodes left")
         candidates = [n for n in trusted if n not in exclude]
         preferred = [n for n in candidates if n in prefer]
         return self._rng.choice(preferred or candidates or trusted)

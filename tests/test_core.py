@@ -20,10 +20,14 @@ from dds_tpu.utils import sigs
 
 
 class Cluster:
-    """In-process cluster: n replicas (+spares), a supervisor, one client."""
+    """In-process cluster: n replicas (+spares), a supervisor, one client.
 
-    def __init__(self, n_active=7, n_sentinent=2, quorum=5, proactive=False):
-        self.net = InMemoryNet()
+    `net` lets chaos suites inject a fault fabric (e.g. a ChaosNet over
+    the default InMemoryNet) without re-plumbing the topology."""
+
+    def __init__(self, n_active=7, n_sentinent=2, quorum=5, proactive=False,
+                 net=None):
+        self.net = net or InMemoryNet()
         self.rcfg = ReplicaConfig(quorum_size=quorum)
         all_addrs = [f"replica-{i}" for i in range(n_active + n_sentinent)]
         self.active = all_addrs[:n_active]

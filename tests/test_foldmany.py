@@ -45,6 +45,38 @@ def test_backend_fold_many_dispatches_kernel_family():
     assert be.modmul_fold_many(folds, n) == [_want(f, n) for f in folds]
 
 
+def test_fold_many_cache_keys_on_karatsuba_mode_and_interpret(monkeypatch):
+    """Flipping DDS_KARATSUBA mid-process must MISS the compiled-fn cache
+    (a stale hit would silently serve the other variant's kernel)."""
+    from dds_tpu.ops.montgomery import ModCtx
+
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    ctx = ModCtx.make(n)
+    monkeypatch.delenv("DDS_KARATSUBA", raising=False)
+    foldmany._fold_many_fn(ctx, "v2", 2)
+    keys_off = {k for k in foldmany._FN_CACHE if k[0] == ctx.n}
+    monkeypatch.setenv("DDS_KARATSUBA", "2")
+    foldmany._fold_many_fn(ctx, "v2", 2)
+    keys_fused = {k for k in foldmany._FN_CACHE if k[0] == ctx.n}
+    assert keys_fused != keys_off  # a NEW entry was compiled, not reused
+    assert any(k[-1] == "fused" for k in keys_fused - keys_off)
+
+
+def test_prod_tb_env_flag_validated_loudly(monkeypatch):
+    """DDS_PROD_TB typos fail at flag-read with an actionable message, not
+    deep inside a trace (ops/flags.prod_tb; used by mont_mxu._tb_for)."""
+    from dds_tpu.ops.flags import prod_tb
+
+    monkeypatch.delenv("DDS_PROD_TB", raising=False)
+    assert prod_tb() is None
+    monkeypatch.setenv("DDS_PROD_TB", "512")
+    assert prod_tb() == 512
+    for bad in ("12eight", "-128", "0", "100"):
+        monkeypatch.setenv("DDS_PROD_TB", bad)
+        with pytest.raises(ValueError, match="DDS_PROD_TB"):
+            prod_tb()
+
+
 def test_fold_many_fuzz_against_int():
     """Randomized shapes: R in 1..6 requests, widths 1..70, two moduli
     sizes, both kernels — every segment's product must match python ints

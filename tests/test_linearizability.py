@@ -13,6 +13,15 @@ register can exhibit:
 
 Also exercises Trudy mid-workload: crashes and compromises within the
 f=2 budget must not break the properties or liveness.
+
+The chaos suite at the bottom re-runs the same history checker under
+seeded ChaosNet fault schedules (partition during writes, delay storms
+during proactive recovery, duplicate/reorder during tag reads, lossy and
+corrupting links, mixed Nemesis attacks): linearizability must hold
+THROUGH the faults and the cluster must converge after heal. Schedules
+are capped by short intervals (ms-scale delays, sub-second partitions)
+and per-op deadline budgets, so the whole suite stays inside the tier-1
+time budget.
 """
 
 import asyncio
@@ -20,8 +29,12 @@ import itertools
 import random
 import time
 
-from dds_tpu.malicious.trudy import Trudy
-from dds_tpu.utils.retry import retry
+import pytest
+
+from dds_tpu.core.chaos import ChaosNet, LinkFaults
+from dds_tpu.core.transport import InMemoryNet
+from dds_tpu.malicious.trudy import Nemesis, Trudy
+from dds_tpu.utils.retry import Deadline, RetryPolicy, retry, retry_deadline
 from tests.test_core import Cluster, run
 
 
@@ -179,6 +192,247 @@ def test_byzantine_faults_mid_workload():
             attacker(),
         )
         check_atomic_register(rec.ops)
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# chaos suite: the SAME atomic-register checker under seeded fault schedules
+# ---------------------------------------------------------------------------
+
+# fast, deadline-governed retry for chaos workloads: ops keep retrying
+# through a fault window and must complete once it heals, within budget
+_CHAOS_POLICY = RetryPolicy(base=0.01, multiplier=2.0, max_delay=0.08)
+
+
+def chaos_cluster(seed, request_timeout=0.25, **kw):
+    net = ChaosNet(InMemoryNet(), seed=seed)
+    c = Cluster(net=net, **kw)
+    c.client.cfg.request_timeout = request_timeout
+    c.client.cfg.breaker_reset = 0.15
+    return c, net
+
+
+async def _chaos_writer(cluster, rec, wid, n_writes, seed, budget=15.0):
+    rng = random.Random(seed)
+    for i in range(n_writes):
+        value = [f"w{wid}-{i}"]
+        t0 = time.monotonic()
+        dl = Deadline(budget)
+        await retry_deadline(
+            lambda: cluster.client.write_set(KEY, value, deadline=dl),
+            dl, _CHAOS_POLICY, rng=rng,
+        )
+        rec.record("write", f"w{wid}-{i}", t0, time.monotonic())
+        await asyncio.sleep(rng.uniform(0, 0.002))
+
+
+async def _chaos_reader(cluster, rec, n_reads, seed, budget=15.0):
+    rng = random.Random(seed)
+    for _ in range(n_reads):
+        t0 = time.monotonic()
+        dl = Deadline(budget)
+        got = await retry_deadline(
+            lambda: cluster.client.fetch_set(KEY, deadline=dl),
+            dl, _CHAOS_POLICY, rng=rng,
+        )
+        rec.record("read", got[0] if got else None, t0, time.monotonic())
+        await asyncio.sleep(rng.uniform(0, 0.002))
+
+
+async def _converged_holders(c, expect):
+    await c.net.quiesce()
+    return [
+        r for r in c.replicas.values()
+        if r.repository.get(KEY, (None, None))[1] == expect
+    ]
+
+
+@pytest.mark.chaos
+def test_chaos_minority_partition_during_writes_linearizable():
+    """Schedule 1: a minority partition (2 of 7) opens mid-workload and
+    heals on a timer; the remaining quorum keeps serving, every recorded
+    history linearizes, and a quorum converges on the final value."""
+
+    async def go():
+        c, net = chaos_cluster(seed=101)
+        rec = Recorder()
+
+        async def attacker():
+            await asyncio.sleep(0.01)
+            net.partition(["replica-5", "replica-6"], duration=0.15)
+
+        await asyncio.gather(
+            _chaos_writer(c, rec, 0, 5, seed=1),
+            _chaos_writer(c, rec, 1, 5, seed=2),
+            _chaos_reader(c, rec, 10, seed=3),
+            attacker(),
+        )
+        check_atomic_register(rec.ops)
+        final = await c.client.fetch_set(KEY)
+        assert len(await _converged_holders(c, final)) >= 5
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_chaos_quorum_breaking_partition_stalls_then_heals():
+    """Schedule 2: partitioning 3 of 7 leaves 4 < quorum — writes STALL
+    (no wrong answers) until the timed heal, then complete within their
+    deadline budgets; the history stays linearizable throughout."""
+
+    async def go():
+        c, net = chaos_cluster(seed=202, request_timeout=0.15)
+        rec = Recorder()
+
+        async def attacker():
+            await asyncio.sleep(0.01)
+            net.partition(
+                ["replica-0", "replica-1", "replica-2"], duration=0.3
+            )
+
+        await asyncio.gather(
+            _chaos_writer(c, rec, 0, 4, seed=4),
+            _chaos_reader(c, rec, 6, seed=5),
+            attacker(),
+        )
+        check_atomic_register(rec.ops)
+        # single writer: its last write is the register's final value
+        assert await c.client.fetch_set(KEY) == ["w0-3"]
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_chaos_delay_storm_during_proactive_recovery():
+    """Schedule 3: jittered delays on EVERY link while the proactive
+    recovery timer swaps replicas mid-workload. Linearizability holds,
+    and after heal the supervisor converges back to full membership."""
+
+    async def go():
+        c, net = chaos_cluster(seed=303, proactive=True)
+        net.default_faults = LinkFaults(delay=0.002, jitter=0.008)
+        c.supervisor.start()
+        rec = Recorder()
+        await asyncio.gather(
+            _chaos_writer(c, rec, 0, 6, seed=6),
+            _chaos_reader(c, rec, 10, seed=7),
+        )
+        net.heal_all()
+        await c.supervisor.stop()
+        await net.quiesce()
+        check_atomic_register(rec.ops)
+        # supervisor converged after heal: membership sizes intact
+        active = [a for a, _ in c.supervisor.active]
+        assert len(active) == len(set(active)) == 7
+        assert len(c.supervisor.sentinent) == 2
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_chaos_duplicate_reorder_during_tag_reads():
+    """Schedule 4: duplication + reordering on the proxy<->replica links
+    while writes interleave with batched tag reads. Duplicated replies
+    must not stuff quorums (votes key by sender), reordered replies must
+    not corrupt correlation, and the final tag round agrees with the last
+    completed write."""
+
+    async def go():
+        c, net = chaos_cluster(seed=404)
+        for i in range(7):
+            net.set_pair(
+                "proxy-0", f"replica-{i}",
+                LinkFaults(duplicate=0.3, reorder=0.3),
+            )
+        rec = Recorder()
+        tag_rounds = {"n": 0}
+
+        async def tag_reader():
+            rng = random.Random(8)
+            for _ in range(8):
+                dl = Deadline(15.0)
+                tags = await retry_deadline(
+                    lambda: c.client.read_tags([KEY], deadline=dl),
+                    dl, _CHAOS_POLICY, rng=rng,
+                )
+                assert len(tags) == 1
+                tag_rounds["n"] += 1
+                await asyncio.sleep(rng.uniform(0, 0.003))
+
+        await asyncio.gather(
+            _chaos_writer(c, rec, 0, 6, seed=9),
+            _chaos_reader(c, rec, 8, seed=10),
+            tag_reader(),
+        )
+        check_atomic_register(rec.ops)
+        assert tag_rounds["n"] == 8
+        await net.quiesce()
+        # the quorum-max tag now equals the last completed write's tag
+        value, tag = await c.client.fetch_set_tagged(KEY)
+        assert value == ["w0-5"]
+        assert (await c.client.read_tags([KEY])) == [tag]
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_chaos_lossy_corrupting_links_linearizable():
+    """Schedule 5: 5% drop + 3% payload corruption + jitter on every link.
+    Corrupted protocol messages must die at the HMAC/codec layers (never
+    surface as values), lost messages are absorbed by retries, and the
+    history still linearizes."""
+
+    async def go():
+        c, net = chaos_cluster(seed=505)
+        net.default_faults = LinkFaults(drop=0.05, corrupt=0.03, jitter=0.003)
+        rec = Recorder()
+        await asyncio.gather(
+            _chaos_writer(c, rec, 0, 5, seed=11),
+            _chaos_writer(c, rec, 1, 5, seed=12),
+            _chaos_reader(c, rec, 8, seed=13),
+        )
+        check_atomic_register(rec.ops)
+        # every read surfaced a genuinely-written value (checker asserts
+        # this) and the workload completed despite the loss schedule
+        assert sum(1 for o in rec.ops if o["kind"] == "write") == 10
+        net.heal_all()
+        final = await c.client.fetch_set(KEY)
+        assert len(await _converged_holders(c, final)) >= 5
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_chaos_nemesis_mixed_attack_schedule():
+    """Schedule 6: Nemesis drives a mixed attack — one replica compromised
+    (byzantine), one partitioned, junk floods at a third — all within the
+    f=2 budget, healed mid-workload. Linearizability and liveness hold."""
+
+    async def go():
+        c, net = chaos_cluster(seed=606)
+        rec = Recorder()
+        nem = Nemesis(net, c.active, max_faults=1, rng=random.Random(42),
+                      flood_messages=15)
+
+        async def attacker():
+            await asyncio.sleep(0.005)
+            byz = nem.trigger("byzantine")
+            # partition a DIFFERENT replica so total faults stay at f=2
+            nem.replicas = [a for a in c.active if a not in byz]
+            cut = nem.trigger("partition")
+            nem.replicas = [a for a in c.active if a not in byz + cut]
+            nem.trigger("flood")
+            await asyncio.sleep(0.12)
+            nem.trigger("heal")
+
+        await asyncio.gather(
+            _chaos_writer(c, rec, 0, 5, seed=14),
+            _chaos_reader(c, rec, 8, seed=15),
+            attacker(),
+        )
+        check_atomic_register(rec.ops)
+        assert await c.client.fetch_set(KEY) == ["w0-4"]
 
     run(go())
 

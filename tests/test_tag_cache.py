@@ -400,9 +400,9 @@ def _count_fetches(server):
     counter = {"n": 0}
     orig = server.abd.fetch_set_attributed
 
-    async def counted(key, exclude=()):
+    async def counted(key, exclude=(), deadline=None):
         counter["n"] += 1
-        return await orig(key, exclude)
+        return await orig(key, exclude, deadline=deadline)
 
     server.abd.fetch_set_attributed = counted
     return counter
