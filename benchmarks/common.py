@@ -5,6 +5,47 @@ from __future__ import annotations
 import json
 import time
 
+# previous kprof snapshot, so each emitted record carries only ITS OWN
+# kernel work (delta), not the whole run's cumulative totals
+_kprof_prev: dict | None = None
+
+
+def _kernel_delta() -> dict | None:
+    """Kernel accounting since the last emit(): dispatch (trace+compile)
+    vs execute ms and compile-cache hit rates from obs.kprof. None when no
+    kernel ran in the window — pure-protocol benchmarks stay clean."""
+    global _kprof_prev
+    from dds_tpu.obs import kprof
+
+    cur = kprof.kernel_summary()
+    prev, _kprof_prev = _kprof_prev, cur
+    # clamp at 0: span-ring eviction can shrink the cumulative totals the
+    # summary is computed from on very long runs
+    d = {
+        "dispatch_ms": round(
+            max(0.0, cur["dispatch_ms"] - (prev["dispatch_ms"] if prev else 0.0)), 3
+        ),
+        "execute_ms": round(
+            max(0.0, cur["execute_ms"] - (prev["execute_ms"] if prev else 0.0)), 3
+        ),
+    }
+    caches = {}
+    for name, c in cur["compile_cache"].items():
+        p = (prev or {}).get("compile_cache", {}).get(name, {})
+        hits = max(0, c["hits"] - p.get("hits", 0))
+        misses = max(0, c["misses"] - p.get("misses", 0))
+        if hits or misses:
+            caches[name] = {
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": round(hits / (hits + misses), 4),
+            }
+    if caches:
+        d["compile_cache"] = caches
+    if d["dispatch_ms"] or d["execute_ms"] or caches:
+        return d
+    return None
+
 
 def best_of(fn, repeats: int = 3) -> float:
     """Min wall-clock seconds over `repeats` timed calls. All calls are
@@ -53,5 +94,11 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float, **detail) -> 
     }
     if detail:
         row["detail"] = detail
+    try:
+        kernel = _kernel_delta()
+    except Exception:
+        kernel = None  # telemetry must never fail a benchmark
+    if kernel is not None:
+        row["kernel"] = kernel
     print(json.dumps(row))
     return row

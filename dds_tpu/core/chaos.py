@@ -36,6 +36,8 @@ from typing import Optional
 
 from dds_tpu.core import messages as M
 from dds_tpu.core.transport import Transport
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.utils.trace import tracer
 
 log = logging.getLogger("dds.chaos")
 
@@ -181,6 +183,16 @@ class ChaosNet(Transport):
     def _note(self, src: str, dest: str, kind: str, action: str) -> None:
         self.trace.append((self._seq, _name(src), _name(dest), kind, action))
         self._seq += 1
+        # Telescope annotations: _note runs synchronously inside send(), so
+        # the event lands on the REQUEST's trace (contextvar still set) —
+        # a post-mortem sees exactly which quorum leg the fabric dropped or
+        # delayed. The metric label is the action family only ("delay", not
+        # "delay=0.0123"): label values must stay bounded.
+        act = action.split("=", 1)[0]
+        metrics.inc("dds_chaos_events_total", action=act,
+                    help="ChaosNet fault injections by action")
+        tracer.event("chaos." + act, src=_name(src), dest=_name(dest),
+                     msg=kind, action=action)
 
     def send(self, src: str, dest: str, msg: object) -> None:
         # every fault decision happens HERE, synchronously in send-call

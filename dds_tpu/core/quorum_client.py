@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -34,6 +35,7 @@ from dds_tpu.core.errors import (
     ByzUnknownReplyError,
 )
 from dds_tpu.core.transport import Transport
+from dds_tpu.obs.metrics import metrics
 from dds_tpu.utils.retry import CircuitBreaker, Deadline, DeadlineExceededError
 from dds_tpu.utils.trace import tracer
 from dds_tpu.utils import sigs
@@ -122,7 +124,8 @@ class AbdClient:
         b = self.breakers.get(node)
         if b is None:
             b = self.breakers[node] = CircuitBreaker(
-                self.cfg.breaker_threshold, self.cfg.breaker_reset
+                self.cfg.breaker_threshold, self.cfg.breaker_reset,
+                name=node.rsplit("/", 1)[-1],
             )
         return b
 
@@ -135,6 +138,11 @@ class AbdClient:
         suspicion strike (cryptographic evidence, never decays) plus a
         breaker failure (steers the next pick away immediately)."""
         self.replicas.increment_suspicion(coord)
+        metrics.inc(
+            "dds_coordinator_violations_total", node=coord.rsplit("/", 1)[-1],
+            help="protocol violations observed per coordinator",
+        )
+        tracer.event("abd.coordinator_violation", node=coord)
         self._breaker(coord).record_failure()
 
     def _attempt_timeout(self, deadline: Optional[Deadline]) -> float:
@@ -150,7 +158,7 @@ class AbdClient:
         return timeout
 
     async def _ask(self, call, nonce: int, signature: bytes, exclude=(),
-                   deadline: Optional[Deadline] = None):
+                   deadline: Optional[Deadline] = None, op: str = "ask"):
         # route around open breakers; defer_to falls back to the full
         # trusted set when everything is excluded (a degraded try beats
         # instant failure, and a success closes the breaker again)
@@ -162,11 +170,17 @@ class AbdClient:
         challenge = nonce + self.cfg.nonce_increment
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[challenge] = (fut, coordinator)
+        t0 = time.perf_counter()
         try:
             self.net.send(self.addr, coordinator, M.Envelope(call, nonce, signature))
             try:
                 reply = await asyncio.wait_for(fut, timeout)
             except asyncio.TimeoutError:
+                metrics.inc(
+                    "dds_quorum_timeouts_total", op=op,
+                    node=coordinator.rsplit("/", 1)[-1],
+                    help="quorum rounds that timed out per coordinator",
+                )
                 # transient unreachability: breaker only — the permanent
                 # suspicion counter is reserved for protocol violations, so
                 # a healed partition's replicas regain coordination without
@@ -174,6 +188,10 @@ class AbdClient:
                 # every timeout and could never un-strike)
                 self._breaker(coordinator).record_failure()
                 raise
+            metrics.observe(
+                "dds_quorum_rtt_seconds", time.perf_counter() - t0, op=op,
+                help="proxy->coordinator quorum round-trip time",
+            )
             return reply, coordinator, challenge
         finally:
             self._pending.pop(challenge, None)
@@ -197,10 +215,11 @@ class AbdClient:
         caller's remaining budget."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce)
-        with tracer.span("abd.fetch"):
+        with tracer.span("abd.fetch") as span_meta:
             reply, coord, challenge = await self._ask(
-                M.IRead(key), nonce, sig, exclude, deadline
+                M.IRead(key), nonce, sig, exclude, deadline, op="fetch"
             )
+            span_meta["coordinator"] = coord
 
         cfg = self.cfg
         match reply:
@@ -233,10 +252,11 @@ class AbdClient:
         """Quorum write; returns (key, tag) where tag is the tag written."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce, value)
-        with tracer.span("abd.write"):
+        with tracer.span("abd.write") as span_meta:
             reply, coord, challenge = await self._ask(
-                M.IWrite(key, value), nonce, sig, (), deadline
+                M.IWrite(key, value), nonce, sig, (), deadline, op="write"
             )
+            span_meta["coordinator"] = coord
 
         cfg = self.cfg
         match reply:
@@ -347,10 +367,16 @@ class AbdClient:
         self._pending_tags[nonce] = (fut, {}, digest, tuple(keys), fingerprint)
         try:
             with tracer.span("abd.read_tags", k=len(keys)):
+                t0 = time.perf_counter()
                 req = M.ReadTagBatch(tuple(keys), nonce, sig, fingerprint)
                 for replica in trusted:
                     self.net.send(self.addr, replica, req)
                 vectors = await asyncio.wait_for(fut, timeout)
+                metrics.observe(
+                    "dds_quorum_rtt_seconds", time.perf_counter() - t0,
+                    op="read_tags",
+                    help="proxy->coordinator quorum round-trip time",
+                )
             if not keys:
                 return []
             if all(v is _UNCHANGED for v in vectors):

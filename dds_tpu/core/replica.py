@@ -29,7 +29,9 @@ from dataclasses import dataclass, field
 
 from dds_tpu.core import messages as M
 from dds_tpu.core.transport import Transport
+from dds_tpu.obs.metrics import metrics
 from dds_tpu.utils import sigs
+from dds_tpu.utils.trace import tracer
 from dds_tpu.utils.trust import TrustedNodesList
 
 log = logging.getLogger("dds.replica")
@@ -116,6 +118,11 @@ class BFTABDNode:
         self.net.send(self.addr, dest, msg)
 
     def _suspect(self, endpoint: str) -> None:
+        tracer.event("replica.suspect", by=self.name, suspect=endpoint)
+        metrics.inc(
+            "dds_suspect_votes_total", suspect=endpoint.rsplit("/", 1)[-1],
+            help="Suspect votes raised toward the supervisor",
+        )
         self._send(self.supervisor, M.Suspect(endpoint, sigs.generate_nonce()))
 
     def _debug(self, text: str) -> None:
@@ -158,6 +165,19 @@ class BFTABDNode:
     # ------------------------------------------------------------- dispatch
 
     async def handle(self, sender: str, msg) -> None:
+        # Per-replica span: the message arrived in a task whose contextvars
+        # were copied at send time (InMemoryNet) or restored from the
+        # frame's `tc` field (TcpNet), so this span slots into the
+        # originating request's trace tree — the per-replica attribution a
+        # process-global ring could never give. `replica` meta identifies
+        # WHICH replica served each quorum leg.
+        with tracer.span(
+            "replica.handle", replica=self.name, msg=type(msg).__name__,
+            behavior=self.behavior,
+        ):
+            await self._dispatch(sender, msg)
+
+    async def _dispatch(self, sender: str, msg) -> None:
         if isinstance(msg, (M.Crash, M.Compromise)):
             # fault-injection backdoors (Trudy): honored only when the
             # deployment enables attack simulation

@@ -37,6 +37,9 @@ from typing import Awaitable, Callable, Optional
 
 from dds_tpu.core import messages as M
 from dds_tpu.core.transport import Transport
+from dds_tpu.obs.flight import flight
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.utils.trace import tracer
 
 log = logging.getLogger("dds.supervisor")
 
@@ -129,6 +132,19 @@ class BFTSupervisor:
                 if len(voters) >= self.cfg.quorum_size:
                     if self.cfg.debug:
                         log.info("replica %s suspected faulty; recovering", replica)
+                    # a suspicion quorum IS a fault event: freeze the
+                    # telemetry that led here before recovery churns it
+                    tracer.event("supervisor.suspicion_quorum",
+                                 replica=replica, voters=len(voters))
+                    metrics.inc(
+                        "dds_suspicion_quorums_total",
+                        replica=replica.rsplit("/", 1)[-1],
+                        help="suspicion quorums reached (recovery triggers)",
+                    )
+                    flight.record(
+                        "suspicion_quorum", replica=replica,
+                        voters=sorted(voters),
+                    )
                     # clear the vote tally NOW so votes landing while the
                     # recovery awaits don't re-trigger it
                     self.quorum[replica] = set()

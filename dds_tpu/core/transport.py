@@ -25,6 +25,7 @@ import logging
 from typing import Awaitable, Callable, Optional
 
 from dds_tpu.core import messages as M
+from dds_tpu.obs import context as obs_context
 
 log = logging.getLogger("dds.transport")
 
@@ -306,11 +307,31 @@ class TcpNet(Transport):
                             "%s: %s", src, e,
                         )
                         continue
-                    asyncio.ensure_future(handler(src, msg))
+                    # restore the sender's trace context (frame `tc`, see
+                    # _send) so spans recorded by the handler join the
+                    # originating request's trace tree across the TCP hop.
+                    # Observability metadata only — outside the MAC, and a
+                    # malformed field degrades to an unlinked span, never
+                    # a dropped message.
+                    tc = obs_context.from_wire(obj.get("tc"))
+                    if tc is not None:
+                        asyncio.ensure_future(
+                            self._handle_traced(handler, tc, src, msg)
+                        )
+                    else:
+                        asyncio.ensure_future(handler(src, msg))
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
             writer.close()
+
+    @staticmethod
+    async def _handle_traced(handler, tc, src: str, msg) -> None:
+        token = obs_context.attach(tc)
+        try:
+            await handler(src, msg)
+        finally:
+            obs_context.detach(token)
 
     def send(self, src: str, dest: str, msg: object) -> None:
         asyncio.ensure_future(self._send(src, dest, msg))
@@ -328,6 +349,11 @@ class TcpNet(Transport):
                     self._conns[conn_key] = w
             payload = M.to_dict(msg)
             obj = {"src": src, "dest": dest, "msg": payload}
+            # trace-context propagation (ensure_future copied the caller's
+            # contextvars into this task, so current() is the sender's span)
+            tc = obs_context.to_wire()
+            if tc is not None:
+                obj["tc"] = tc
             if self._frame_secret is not None or self._node_key is not None:
                 ctr = next(self._send_ctr) if self._node_key is not None else None
                 if ctr is not None:

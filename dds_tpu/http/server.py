@@ -30,6 +30,7 @@ import asyncio
 import contextvars
 import logging
 import ssl
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -38,6 +39,9 @@ from dds_tpu.core.quorum_client import AbdClient
 from dds_tpu.http import json_protocol as J
 from dds_tpu.http.miniserver import HttpServer, Request, Response, http_request
 from dds_tpu.models.backend import CryptoBackend, get_backend
+from dds_tpu.obs import context as obs_context
+from dds_tpu.obs.flight import flight
+from dds_tpu.obs.metrics import SIZE_BUCKETS, metrics
 from dds_tpu.utils import sigs
 from dds_tpu.utils.retry import (
     Deadline,
@@ -143,6 +147,12 @@ class ProxyConfig:
     # behind debug flags too (dds-system.conf:61-62). launch() enables it
     # for debug deployments.
     trace_route_enabled: bool = False
+    # GET /metrics (Prometheus text, obs/metrics). Default ON: scrapers
+    # are how the "production-scale" posture monitors this thing, and the
+    # aggregated series reveal far less workload shape than /_trace's
+    # per-span stats. Deployments that must hide even rates can turn it
+    # off (config `obs.metrics_route = false`).
+    metrics_route_enabled: bool = True
     # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
     replica_refresh_interval: float = 5.0
     supervisor: Optional[str] = None
@@ -487,6 +497,11 @@ class DDSRestServer:
                     pm = self._pairs_memo
                     if pm is not None and pm[0] == state:
                         if await self._audit_cached(cached):
+                            metrics.inc(
+                                "dds_tag_cache_total", len(cached),
+                                outcome="hit",
+                                help="aggregate tag-cache keys by outcome",
+                            )
                             return pm[1]
                         # audit flushed the cache: rebuild from quorum reads
                     else:
@@ -523,6 +538,13 @@ class DDSRestServer:
             if isinstance(r, Exception):
                 raise r
             fetched[k] = r  # (value, tag, coordinator)
+        # cache effectiveness: keys served from the tag-validated cache vs
+        # re-read through full quorums (audit re-reads count as misses —
+        # they cost a full ABD round either way)
+        metrics.inc("dds_tag_cache_total", max(0, len(keys) - len(stale)),
+                    outcome="hit", help="aggregate tag-cache keys by outcome")
+        metrics.inc("dds_tag_cache_total", len(stale), outcome="miss",
+                    help="aggregate tag-cache keys by outcome")
         pre = {k: (fresh_tags[k], fresh[k]) for k in audit}
         forged = await self._audit_verdict(audit, pre, fetched)
         if forged:
@@ -622,26 +644,62 @@ class DDSRestServer:
 
     async def handle(self, req: Request) -> Response:
         route = req.path.split("/", 2)[1] if "/" in req.path else req.path
+        # Trace root minted at the edge (or stitched under an upstream
+        # caller's x-dds-trace header): every span recorded below — quorum
+        # rounds, replica handlers scheduled over the transport, kernel
+        # phases — links into this request's tree via obs.context.
+        upstream = obs_context.from_header(req.headers.get("x-dds-trace", ""))
+        ctx = obs_context.child(upstream) if upstream else obs_context.root()
         # ONE budget per request: every storage helper below reads it from
         # the context var, so nested retries and per-attempt timeouts all
         # shrink toward the same edge deadline
         token = _REQ_DEADLINE.set(Deadline(self.cfg.request_budget))
+        t0 = time.perf_counter()
+        status = 500
         try:
-            with tracer.span(f"http.{req.method}.{route or 'root'}"):
-                return await self._route(req)
+            with tracer.span(f"http.{req.method}.{route or 'root'}", _ctx=ctx):
+                resp = await self._route(req)
+            status = resp.status
+            return resp
         except (ValueError, KeyError, TypeError) as e:
+            status = 400
             return Response.text(f"bad request: {e}", 400)
         except (DeadlineExceededError, NoTrustedNodesError) as e:
             # graceful degradation: the quorum is unreachable within the
             # budget — tell the client WHEN to come back instead of hanging
             # or aborting opaquely
+            status = 503
             log.warning("degraded %s %s: %s", req.method, req.path, e)
+            kind = (
+                "deadline_exceeded"
+                if isinstance(e, DeadlineExceededError)
+                else "no_trusted_nodes"
+            )
+            metrics.inc(
+                "dds_degraded_total", route=route or "root", kind=kind,
+                help="requests degraded to 503 (budget exhausted / no quorum)",
+            )
+            # the faulting request's whole span tree, frozen for post-mortem
+            flight.record(
+                kind, trace_id=ctx.trace_id, route=route or "root",
+                method=req.method, error=str(e),
+            )
             return self._unavailable(str(e))
         except Exception:
             log.exception("route failure %s %s", req.method, req.path)
             return Response(500)
         finally:
             _REQ_DEADLINE.reset(token)
+            metrics.observe(
+                "dds_http_request_seconds", time.perf_counter() - t0,
+                route=route or "root", method=req.method,
+                help="REST request latency by route",
+            )
+            metrics.inc(
+                "dds_http_requests_total", route=route or "root",
+                method=req.method, status=str(status),
+                help="REST requests by route and status",
+            )
 
     def _unavailable(self, why: str) -> Response:
         import math
@@ -853,16 +911,57 @@ class DDSRestServer:
                     )
                 return resp
 
+            case ("GET", "metrics") if self.cfg.metrics_route_enabled:
+                # Prometheus text exposition (obs/metrics). State gauges
+                # (breakers, suspicion, membership) are sampled at scrape
+                # time — cheaper than updating them on every transition,
+                # and scrape-time freshness is all a gauge promises.
+                self._sample_state_gauges()
+                return Response(
+                    200,
+                    metrics.render().encode(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                )
+
             case ("GET", "_trace") if self.cfg.trace_route_enabled:
                 # live observability (SURVEY §5.5): per-span timing summary
-                # (count/total/mean/p50/p95 ms) + counters from utils/trace.
+                # (count/total/mean/p50/p95 ms) from utils/trace, counters
+                # under their OWN key (they are occurrences, not durations —
+                # mixing them into span counts skewed every mean/percentile).
                 # Config-gated (reveals workload shape); no ciphertexts or
                 # keys leave — span metadata is aggregate timing only.
                 return Response.json(
-                    {"spans": tracer.summary(), "stored_keys": len(self.stored_keys)}
+                    {
+                        "spans": tracer.summary(),
+                        "counters": tracer.counters(),
+                        "stored_keys": len(self.stored_keys),
+                    }
                 )
 
         return Response(404)
+
+    _BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+
+    def _sample_state_gauges(self) -> None:
+        """Refresh scrape-time gauges: breaker + suspicion state per
+        coordinator, membership counts, store size."""
+        for node, state in self.abd.breaker_states().items():
+            metrics.set(
+                "dds_breaker_state", self._BREAKER_STATE_CODE.get(state, -1),
+                node=node.rsplit("/", 1)[-1],
+                help="per-coordinator breaker: 0=closed 1=half_open 2=open",
+            )
+        for node, strikes in self.abd.replicas.suspicions().items():
+            metrics.set(
+                "dds_replica_suspicion", strikes, node=node.rsplit("/", 1)[-1],
+                help="permanent protocol-violation strikes per replica",
+            )
+        metrics.set(
+            "dds_trusted_replicas", len(self.abd.replicas.get_trusted()),
+            help="replicas under the 3-strike suspicion limit",
+        )
+        metrics.set("dds_stored_keys", len(self.stored_keys),
+                    help="aggregate key-set size")
 
     # ----------------------------------------------------- aggregate helpers
 
@@ -902,6 +1001,10 @@ class DDSRestServer:
             self._operand_memo = (pairs, pos, operands)
         if not operands:
             return Response(404)
+        metrics.observe(
+            "dds_fold_batch_size", len(operands), buckets=SIZE_BUCKETS,
+            help="aggregate fold width (operand count)",
+        )
         if mod:
             modulus = self._parse_modulus(mod, modparam)
             # device-resident path when the backend has a cipher store:

@@ -24,6 +24,9 @@ import random
 from dds_tpu.core import messages as M
 from dds_tpu.core.chaos import ChaosNet, LinkFaults
 from dds_tpu.core.transport import Transport
+from dds_tpu.obs.flight import flight
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.utils.trace import tracer
 
 log = logging.getLogger("dds.trudy")
 
@@ -63,6 +66,17 @@ class Trudy:
             self.replicas, min(self.max_faults, len(self.replicas))
         )
 
+    @staticmethod
+    def _note_attack(attack: AttackType, victims: list[str]) -> None:
+        """Telemetry for every injected attack: trace event + counter +
+        flight-recorder incident, so a chaos-suite failure records which
+        fault fired and at whom (self-describing post-mortems)."""
+        names = [v.rsplit("/", 1)[-1] for v in victims]
+        tracer.event("attack." + attack.value, victims=names)
+        metrics.inc("dds_attacks_total", type=attack.value,
+                    help="Trudy/Nemesis attacks triggered by type")
+        flight.record("attack_" + attack.value, victims=names)
+
     def trigger(self, attack: AttackType | str) -> list[str]:
         """Attack up to max_faults random replicas; returns the victims.
 
@@ -84,6 +98,7 @@ class Trudy:
                 raise ValueError(
                     f"{attack.value!r} is a Nemesis attack — use Nemesis"
                 )
+        self._note_attack(attack, victims)
         return victims
 
 
@@ -133,6 +148,7 @@ class Nemesis(Trudy):
             log.info("Nemesis heals the network")
             self._chaos().heal_all()
             self.active_partitions.clear()
+            self._note_attack(attack, [])
             return []
         victims = self._victims()
         if attack is AttackType.PARTITION:
@@ -164,4 +180,5 @@ class Nemesis(Trudy):
                             b"nemesis-junk",
                         ),
                     )
+        self._note_attack(attack, victims)
         return victims

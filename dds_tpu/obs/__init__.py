@@ -1,0 +1,26 @@
+"""Telescope: the observability plane (tracing, metrics, flight recorder).
+
+- `obs.context` — distributed trace-context propagation (contextvar +
+  transport wire format); `utils/trace.tracer` records spans against it.
+- `obs.metrics` — process-wide MetricsRegistry, Prometheus text at
+  `GET /metrics` (http/server.py).
+- `obs.flight` — fault-triggered incident dumps (JSONL post-mortems).
+- `obs.kprof` — kernel dispatch/compile-vs-execute profiling hooks.
+
+`flight` and `kprof` import `utils/trace`, which imports `obs.context` —
+so this package eagerly exposes only the leaf modules and lazily resolves
+the rest (PEP 562) to keep the import graph acyclic.
+"""
+
+from dds_tpu.obs import context  # noqa: F401
+from dds_tpu.obs.metrics import Registry, metrics  # noqa: F401
+
+__all__ = ["context", "metrics", "Registry", "flight", "kprof"]
+
+
+def __getattr__(name):
+    if name in ("flight", "kprof"):
+        import importlib
+
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

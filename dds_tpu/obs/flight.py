@@ -1,0 +1,154 @@
+"""Flight recorder: fault-triggered incident dumps for post-mortems.
+
+When the stack detects a fault — a suspicion quorum, a circuit breaker
+opening, a request budget exhausting (`DeadlineExceededError`), a
+Trudy/Nemesis attack firing — the in-memory telemetry that explains it is
+about to be overwritten by the span ring. The flight recorder freezes it:
+one JSONL incident file per fault with a header record (fault kind, info,
+live counters, span summary) followed by the faulting trace's full span
+tree and the tail of the span ring. Every chaos-suite failure becomes
+self-describing instead of un-reproducible.
+
+Disabled unless given a directory (config `obs.flight_dir` or env
+`DDS_OBS_FLIGHT_DIR`) — recording is a disk write on a fault path, so it
+must be opt-in and can never raise into the caller. Incidents are
+rate-limited per kind (`min_interval`) and pruned to `max_incidents`
+files, so a flapping breaker cannot fill a disk. Writes are atomic
+(tmp + rename): a crash mid-dump leaves no truncated incident.
+
+Env flags: DDS_OBS_FLIGHT_DIR, DDS_OBS_FLIGHT_MAX (default 32),
+DDS_OBS_FLIGHT_INTERVAL (seconds per kind, default 1.0).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+import threading
+import time
+
+from dds_tpu.obs import context as obs_context
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.utils.trace import tracer
+
+log = logging.getLogger("dds.flight")
+
+__all__ = ["FlightRecorder", "flight"]
+
+
+class FlightRecorder:
+    # span-ring tail included in every incident alongside the faulting trace
+    RING_TAIL = 512
+
+    def __init__(self, dir: str | None = None, max_incidents: int | None = None,
+                 min_interval: float | None = None):
+        env_dir = os.environ.get("DDS_OBS_FLIGHT_DIR", "")
+        self.dir = dir if dir is not None else (env_dir or None)
+        self.max_incidents = (
+            max_incidents
+            if max_incidents is not None
+            else int(os.environ.get("DDS_OBS_FLIGHT_MAX", "32") or 32)
+        )
+        self.min_interval = (
+            min_interval
+            if min_interval is not None
+            else float(os.environ.get("DDS_OBS_FLIGHT_INTERVAL", "1.0") or 1.0)
+        )
+        self._lock = threading.Lock()
+        self._last: dict[str, float] = {}  # kind -> monotonic ts of last dump
+        self._seq = 0
+
+    def configure(self, dir: str | None = None, max_incidents: int | None = None,
+                  min_interval: float | None = None) -> None:
+        """Late wiring from a deployment config (run.launch)."""
+        if dir is not None:
+            self.dir = dir or None
+        if max_incidents is not None:
+            self.max_incidents = max_incidents
+        if min_interval is not None:
+            self.min_interval = min_interval
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.dir)
+
+    def record(self, kind: str, trace_id: str | None = None, **info):
+        """Dump one incident; returns its path, or None (disabled /
+        rate-limited / write failure — never raises). `trace_id` defaults
+        to the active trace so the faulting request's tree is captured."""
+        if not self.enabled:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            last = self._last.get(kind)
+            if last is not None and now - last < self.min_interval:
+                metrics.inc(
+                    "dds_incidents_suppressed_total", kind=kind,
+                    help="flight-recorder dumps skipped by rate limiting",
+                )
+                return None
+            self._last[kind] = now
+            self._seq += 1
+            seq = self._seq
+        if trace_id is None:
+            cur = obs_context.current()
+            trace_id = cur.trace_id if cur is not None else None
+        try:
+            return self._write(kind, seq, trace_id, info)
+        except OSError as e:
+            log.warning("flight recorder dump for %r failed: %s", kind, e)
+            return None
+
+    # ----------------------------------------------------------- internals
+
+    def _write(self, kind: str, seq: int, trace_id: str | None, info: dict):
+        events = tracer.events()
+        faulting = (
+            [e for e in events if e.trace_id == trace_id] if trace_id else []
+        )
+        tail = events[-self.RING_TAIL:]
+        header = {
+            "incident": kind,
+            "ts": time.time(),
+            "trace_id": trace_id,
+            "info": info,
+            "counters": tracer.counters(),
+            "summary": tracer.summary(),
+            "trace_spans": len(faulting),
+            "ring_tail": len(tail),
+        }
+        d = pathlib.Path(self.dir)
+        d.mkdir(parents=True, exist_ok=True)
+        safe_kind = "".join(c if c.isalnum() or c in "-_" else "_" for c in kind)
+        name = f"incident-{int(time.time() * 1e3):013d}-{seq:04d}-{safe_kind}.jsonl"
+        tmp = d / (name + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header, default=str) + "\n")
+            for e in faulting:
+                f.write(json.dumps(
+                    {"section": "trace", **tracer.event_dict(e)}, default=str,
+                ) + "\n")
+            for e in tail:
+                f.write(json.dumps(
+                    {"section": "ring", **tracer.event_dict(e)}, default=str,
+                ) + "\n")
+        path = d / name
+        os.replace(tmp, path)
+        metrics.inc("dds_incidents_total", kind=kind,
+                    help="flight-recorder incident dumps written")
+        self._prune(d)
+        return str(path)
+
+    def _prune(self, d: pathlib.Path) -> None:
+        incidents = sorted(d.glob("incident-*.jsonl"))
+        for old in incidents[: max(0, len(incidents) - self.max_incidents)]:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+
+
+# process-wide recorder; run.launch() configures it from DDSConfig.obs
+flight = FlightRecorder()

@@ -1,0 +1,112 @@
+"""Kernel profiling hooks: dispatch(trace/compile) vs execute, cache hits.
+
+"HEAAN Demystified" (arxiv 2003.04510) argues HE acceleration must start
+from per-phase bottleneck accounting, and GPU HE accelerators (GME, arxiv
+2309.11001) report compile-vs-execute splits per kernel. JAX hides the
+boundary: calling a jitted fn returns as soon as the work is ENQUEUED
+(having traced+compiled first on a cache miss), and only
+`block_until_ready` exposes device time. `profiled()` separates the two
+into distinct tracer spans and metrics histograms; `cache_event`/`counted`
+account compile-cache hits vs misses for the manual dict caches
+(ops/foldmany) and `functools.lru_cache`d builders (ops/mont_mxu).
+
+`kernel_summary()` condenses both for benchmark records
+(benchmarks/common.emit attaches it to every row in results.json).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.utils.trace import tracer
+
+__all__ = ["cache_event", "counted", "profiled", "kernel_summary", "reset"]
+
+_lock = threading.Lock()
+_cache_stats: dict[str, list[int]] = {}  # cache name -> [hits, misses]
+
+
+def cache_event(cache: str, hit: bool) -> None:
+    """Record one compile-cache lookup (per kernel-builder cache)."""
+    with _lock:
+        s = _cache_stats.setdefault(cache, [0, 0])
+        s[0 if hit else 1] += 1
+    metrics.inc(
+        "dds_compile_cache_total", cache=cache,
+        outcome="hit" if hit else "miss",
+        help="kernel compile-cache lookups by outcome",
+    )
+
+
+def counted(cache: str, lru_fn, *args):
+    """Call a `functools.lru_cache`d kernel builder, accounting the lookup
+    as a compile-cache hit/miss via its cache_info miss delta."""
+    before = lru_fn.cache_info().misses
+    out = lru_fn(*args)
+    cache_event(cache, hit=lru_fn.cache_info().misses == before)
+    return out
+
+
+def profiled(kernel: str, dispatch, **meta):
+    """Run `dispatch()` (enqueue device work, return jax arrays) and time
+    its two phases separately: the dispatch call (which *includes*
+    trace+compile on a compile-cache miss) and the `block_until_ready`
+    device execution. Both land as `kernel.<name>.dispatch` /
+    `kernel.<name>.execute` spans plus metrics histograms; returns the
+    (ready) dispatch result."""
+    import jax
+
+    t0 = time.perf_counter()
+    out = dispatch()
+    t1 = time.perf_counter()
+    jax.block_until_ready(out)
+    t2 = time.perf_counter()
+    tracer.record(f"kernel.{kernel}.dispatch", (t1 - t0) * 1e3, **meta)
+    tracer.record(f"kernel.{kernel}.execute", (t2 - t1) * 1e3, **meta)
+    metrics.observe(
+        "dds_kernel_dispatch_seconds", t1 - t0, kernel=kernel,
+        help="host-side dispatch time (includes trace+compile on cache miss)",
+    )
+    metrics.observe(
+        "dds_kernel_execute_seconds", t2 - t1, kernel=kernel,
+        help="device execute time (block_until_ready)",
+    )
+    return out
+
+
+def kernel_summary() -> dict:
+    """{spans, compile_cache, dispatch_ms, execute_ms} over kernel.* spans
+    recorded so far — the per-record accounting benchmarks attach."""
+    spans = {
+        name: stats
+        for name, stats in tracer.summary().items()
+        if name.startswith("kernel.")
+    }
+    with _lock:
+        caches = {
+            name: {
+                "hits": h,
+                "misses": m,
+                "hit_rate": round(h / (h + m), 4) if h + m else None,
+            }
+            for name, (h, m) in sorted(_cache_stats.items())
+        }
+    dispatch_ms = sum(
+        s["total_ms"] for n, s in spans.items() if n.endswith(".dispatch")
+    )
+    execute_ms = sum(
+        s["total_ms"] for n, s in spans.items() if n.endswith(".execute")
+    )
+    return {
+        "spans": spans,
+        "compile_cache": caches,
+        "dispatch_ms": round(dispatch_ms, 3),
+        "execute_ms": round(execute_ms, 3),
+    }
+
+
+def reset() -> None:
+    with _lock:
+        _cache_stats.clear()

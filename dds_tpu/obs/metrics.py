@@ -1,0 +1,176 @@
+"""MetricsRegistry: counters, gauges, fixed-bucket histograms; Prometheus text.
+
+The numeric half of Telescope (the span ring in `utils/trace` is the
+temporal half): subsystems increment named series with bounded label sets
+(route, method, coordinator, cache, outcome, ...) and `GET /metrics`
+serves the whole registry in Prometheus text exposition format 0.0.4 —
+stdlib only, no client library.
+
+Design notes:
+- one process-wide registry (`metrics`); a `Registry()` can be built for
+  tests.
+- histograms are FIXED-bucket (chosen at first observe): cumulative
+  `_bucket{le=...}` counts plus `_sum`/`_count`, the standard shape
+  Prometheus quantile queries expect. No dynamic buckets — re-bucketing
+  mid-flight would corrupt rate() queries.
+- every mutation takes one short lock; the hot-path cost is a dict lookup
+  and a float add, matching the tracer's "one deque append" budget.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Registry", "metrics",
+    "LATENCY_BUCKETS", "SIZE_BUCKETS",
+]
+
+# seconds: 1ms .. 10s, the REST/quorum latency range under chaos schedules
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+# element counts: fold widths / batch sizes
+SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096, 16384)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(value: float) -> str:
+    # integers render without a trailing .0 — smaller payloads, and exact
+    # counter values survive a text round-trip
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+@dataclass
+class _Family:
+    kind: str                      # counter | gauge | histogram
+    help: str = ""
+    buckets: tuple = ()
+    # label-key -> float (counter/gauge) or [bucket_counts, sum, count]
+    samples: dict = field(default_factory=dict)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+
+    # -------------------------------------------------------------- writes
+
+    def _family(self, name: str, kind: str, help: str, buckets: tuple = ()):
+        fam = self._families.get(name)
+        if fam is None:
+            fam = self._families[name] = _Family(kind, help, buckets)
+        elif fam.kind != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as {fam.kind}, not {kind}"
+            )
+        return fam
+
+    def inc(self, name: str, n: float = 1.0, help: str = "", **labels) -> None:
+        """Add `n` to a counter series (created on first touch)."""
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._family(name, "counter", help)
+            fam.samples[key] = fam.samples.get(key, 0.0) + n
+
+    def set(self, name: str, value: float, help: str = "", **labels) -> None:
+        """Set a gauge series to `value`."""
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._family(name, "gauge", help)
+            fam.samples[key] = float(value)
+
+    def observe(self, name: str, value: float, buckets: tuple = LATENCY_BUCKETS,
+                help: str = "", **labels) -> None:
+        """Record one observation into a fixed-bucket histogram series."""
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._family(name, "histogram", help, tuple(buckets))
+            s = fam.samples.get(key)
+            if s is None:
+                s = fam.samples[key] = [[0] * len(fam.buckets), 0.0, 0]
+            i = bisect.bisect_left(fam.buckets, value)
+            if i < len(fam.buckets):
+                s[0][i] += 1
+            s[1] += value
+            s[2] += 1
+
+    # --------------------------------------------------------------- reads
+
+    def value(self, name: str, **labels) -> float | None:
+        """Current counter/gauge value of one series (tests/introspection)."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam.kind == "histogram":
+                return None
+            return fam.samples.get(_label_key(labels))
+
+    def histogram_stats(self, name: str, **labels) -> dict | None:
+        """{count, sum} of one histogram series."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam.kind != "histogram":
+                return None
+            s = fam.samples.get(_label_key(labels))
+            if s is None:
+                return None
+            return {"count": s[2], "sum": s[1]}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._families.clear()
+
+    # ---------------------------------------------------------- exposition
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        out: list[str] = []
+        with self._lock:
+            for name in sorted(self._families):
+                fam = self._families[name]
+                if fam.help:
+                    out.append(f"# HELP {name} {fam.help}")
+                out.append(f"# TYPE {name} {fam.kind}")
+                for key in sorted(fam.samples):
+                    labels = dict(key)
+                    if fam.kind == "histogram":
+                        counts, total, count = fam.samples[key]
+                        cum = 0
+                        for le, c in zip(fam.buckets, counts):
+                            cum += c
+                            out.append(
+                                f"{name}_bucket{{{self._labels(labels, le=_fmt(le))}}} {cum}"
+                            )
+                        out.append(
+                            f'{name}_bucket{{{self._labels(labels, le="+Inf")}}} {count}'
+                        )
+                        suffix = self._labels(labels)
+                        brace = f"{{{suffix}}}" if suffix else ""
+                        out.append(f"{name}_sum{brace} {_fmt(total)}")
+                        out.append(f"{name}_count{brace} {count}")
+                    else:
+                        suffix = self._labels(labels)
+                        brace = f"{{{suffix}}}" if suffix else ""
+                        out.append(f"{name}{brace} {_fmt(fam.samples[key])}")
+        return "\n".join(out) + "\n"
+
+    @staticmethod
+    def _labels(labels: dict, **extra) -> str:
+        items = {**labels, **extra}
+        return ",".join(f'{k}="{_escape(str(v))}"' for k, v in items.items())
+
+
+# process-wide default registry (subsystems import this)
+metrics = Registry()

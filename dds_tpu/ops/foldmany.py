@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dds_tpu.obs import kprof
 from dds_tpu.ops import bignum as bn
 from dds_tpu.ops.flags import karatsuba_mode
 from dds_tpu.ops.montgomery import ModCtx, _mont_mul_raw
@@ -69,6 +70,7 @@ def _fold_many_fn(ctx: ModCtx, kernel: str, R: int):
     kmode = karatsuba_mode() if kernel == "v2" else None
     key = (ctx.n, kernel, R, interpret, kmode)
     fn = _FN_CACHE.get(key)
+    kprof.cache_event("foldmany", hit=fn is not None)
     if fn is not None:
         return fn
     mul = _mul_bm(ctx, kernel, interpret)
@@ -115,7 +117,13 @@ def fold_many(folds: list[list[int]], modulus: int, kernel: str = "jnp") -> list
         ]
         + [bn.int_to_limbs(R_ % ctx.n, ctx.L)] * (Rp - R_real)  # dummies: K=1
     )
-    out = _fold_many_fn(ctx, kernel, Rp)(
-        jnp.asarray(arr.reshape(P2 * Rp, ctx.L)), jnp.asarray(fixes)
+    fn = _fold_many_fn(ctx, kernel, Rp)
+    # dispatch (trace+compile on a cold cache) vs device execute, timed
+    # separately (obs/kprof): the compile-vs-execute accounting GPU/TPU HE
+    # work sizes kernels by
+    out = kprof.profiled(
+        "foldmany",
+        lambda: fn(jnp.asarray(arr.reshape(P2 * Rp, ctx.L)), jnp.asarray(fixes)),
+        R=R_real, P2=P2,
     )
     return [bn.limbs_to_int(row) for row in np.asarray(out)[:R_real]]

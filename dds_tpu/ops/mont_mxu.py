@@ -658,9 +658,13 @@ def pow_mod2(mctx: MxuCtx, bases, exp: int, interpret: bool | None = None):
     if exp == 0:
         return jnp.asarray(bn.ones_batch(bases.shape[0], mctx.ctx.L))
     digits = jnp.asarray(_exp_to_digits(exp).astype(np.int32))
-    return _pow2_fn(mctx, int(digits.shape[0]), interpret, _use_karatsuba())(
-        jnp.asarray(bases), digits
+    from dds_tpu.obs import kprof
+
+    fn = kprof.counted(
+        "mont_mxu.pow2", _pow2_fn,
+        mctx, int(digits.shape[0]), interpret, _use_karatsuba(),
     )
+    return fn(jnp.asarray(bases), digits)
 
 
 @functools.lru_cache(maxsize=None)
@@ -693,6 +697,9 @@ def reduce_mul2(mctx: MxuCtx, cs, interpret: bool | None = None):
     if P2 != K:
         pad = jnp.broadcast_to(jnp.asarray(ctx.one_mont), (P2 - K, ctx.L))
         cs = jnp.concatenate([cs, pad], axis=0)
-    return _reduce2_fn(mctx, P2, interpret, _use_karatsuba())(
-        cs, _fold_fix(ctx, K)
+    from dds_tpu.obs import kprof
+
+    fn = kprof.counted(
+        "mont_mxu.reduce2", _reduce2_fn, mctx, P2, interpret, _use_karatsuba()
     )
+    return fn(cs, _fold_fix(ctx, K))

@@ -25,6 +25,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from dds_tpu.obs import kprof
+from dds_tpu.obs.metrics import metrics
 from dds_tpu.ops import bignum as bn
 from dds_tpu.ops.montgomery import ModCtx
 from dds_tpu.utils.trace import tracer
@@ -175,6 +177,16 @@ class DeviceCipherStore:
             )
         else:
             rows = jnp.take(buf, jnp.asarray(idx), axis=0)
+        metrics.inc(
+            "dds_cipher_store_total", len(cs) - len(missing), outcome="resident",
+            help="fold operands served from device-resident rows vs ingested",
+        )
+        metrics.inc("dds_cipher_store_total", len(missing), outcome="ingested",
+                    help="fold operands served from device-resident rows vs ingested")
         with tracer.span("kernel.fold", k=len(cs), resident=idx is not None):
-            out = self.reduce(rows)
+            # dispatch (trace/compile) timed apart from block_until_ready
+            # device execution (obs/kprof) — the split the flat span hid
+            out = kprof.profiled(
+                "store.reduce", lambda: self.reduce(rows), k=len(cs),
+            )
             return bn.limbs_to_int(np.asarray(out)[0])

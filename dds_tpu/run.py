@@ -60,6 +60,18 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     cfg = cfg or DDSConfig()
     stoppables = []
 
+    # Telescope wiring: hand the process-wide flight recorder its incident
+    # directory (it stays disabled without one — fault-path disk writes
+    # are opt-in)
+    if cfg.obs.flight_dir:
+        from dds_tpu.obs.flight import flight
+
+        flight.configure(
+            dir=cfg.obs.flight_dir,
+            max_incidents=cfg.obs.flight_max_incidents,
+            min_interval=cfg.obs.flight_min_interval,
+        )
+
     # mutual TLS on the HTTP hops (SURVEY §2.14/§2.20 posture, configurable)
     sec = cfg.security
     ssl_server = ssl_client = None
@@ -320,7 +332,8 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             keys_path=cfg.proxy.stored_keys_path,
             coalesce_window=cfg.proxy.coalesce_window,
             supervisor=sup_addr,
-            trace_route_enabled=cfg.debug,
+            trace_route_enabled=cfg.debug or cfg.obs.trace_route,
+            metrics_route_enabled=cfg.obs.metrics_route,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),

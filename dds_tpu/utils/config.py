@@ -176,6 +176,28 @@ class ClientSettings:
 
 
 @dataclass
+class ObsConfig:
+    """Telescope (dds_tpu/obs) wiring. Env-flag twins exist for harnesses
+    that cannot pass a config: DDS_OBS_FLIGHT_DIR / DDS_OBS_FLIGHT_MAX /
+    DDS_OBS_FLIGHT_INTERVAL (flight recorder), DDS_OBS_RING /
+    DDS_OBS_TRACE (tracer ring size / kill switch)."""
+
+    # GET /metrics (Prometheus text). On by default — aggregated series,
+    # the scrape plane production monitoring expects.
+    metrics_route: bool = True
+    # GET /_trace (per-span stats; reveals workload shape). `debug = true`
+    # also enables it, preserving the old behavior.
+    trace_route: bool = False
+    # flight recorder: directory for fault-triggered JSONL incident dumps
+    # (empty = disabled unless DDS_OBS_FLIGHT_DIR is set)
+    flight_dir: str = ""
+    flight_max_incidents: int = 32
+    # min seconds between incidents of the same kind (a flapping breaker
+    # must not fill a disk)
+    flight_min_interval: float = 1.0
+
+
+@dataclass
 class AttackConfig:
     enabled: bool = False
     # crash | byzantine | partition | delay | flood | heal (the network
@@ -197,6 +219,7 @@ class DDSConfig:
     transport: TransportConfig = field(default_factory=TransportConfig)
     client: ClientSettings = field(default_factory=ClientSettings)
     attacks: AttackConfig = field(default_factory=AttackConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     debug: bool = False
 
     # ------------------------------------------------------------- loading
@@ -240,5 +263,6 @@ _SUBSECTIONS = {
     ("DDSConfig", "transport"): TransportConfig,
     ("DDSConfig", "client"): ClientSettings,
     ("DDSConfig", "attacks"): AttackConfig,
+    ("DDSConfig", "obs"): ObsConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
 }

@@ -32,6 +32,9 @@ import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional, TypeVar
 
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.utils.trace import tracer
+
 T = TypeVar("T")
 
 
@@ -125,6 +128,13 @@ async def retry_deadline(
             return await f()
         except retry_on as e:
             attempt += 1
+            # annotate the active trace + the retry-pressure counter: under
+            # a chaos schedule these are how a post-mortem distinguishes
+            # "slow but clean" from "every round fought the network"
+            tracer.event("retry.attempt", attempt=attempt,
+                         error=type(e).__name__)
+            metrics.inc("dds_retry_attempts_total", error=type(e).__name__,
+                        help="storage-layer attempts that failed and retried")
             if policy.max_attempts is not None and attempt >= policy.max_attempts:
                 raise
             delay = policy.backoff(attempt - 1, rng)
@@ -177,9 +187,11 @@ class CircuitBreaker:
         failure_threshold: int = 3,
         reset_timeout: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
+        name: str = "",
     ):
         self.failure_threshold = failure_threshold
         self.reset_timeout = reset_timeout
+        self.name = name  # guarded target, for telemetry attribution
         self._clock = clock
         self._state = self.CLOSED
         self._failures = 0
@@ -190,12 +202,27 @@ class CircuitBreaker:
         self._maybe_half_open()
         return self._state
 
+    def _transition(self, state: str) -> None:
+        if state == self._state:
+            return
+        self._state = state
+        tracer.event("breaker." + state, target=self.name)
+        metrics.inc("dds_breaker_transitions_total", state=state,
+                    target=self.name,
+                    help="circuit-breaker state transitions per target")
+        if state == self.OPEN:
+            # a breaker opening IS a fault: freeze the telemetry that led
+            # here (no-op unless a flight directory is configured)
+            from dds_tpu.obs.flight import flight
+
+            flight.record("breaker_open", target=self.name)
+
     def _maybe_half_open(self) -> None:
         if (
             self._state == self.OPEN
             and self._clock() - self._opened_at >= self.reset_timeout
         ):
-            self._state = self.HALF_OPEN
+            self._transition(self.HALF_OPEN)
 
     def allow(self) -> bool:
         """May the caller route a request at this target right now?"""
@@ -203,7 +230,7 @@ class CircuitBreaker:
         return self._state != self.OPEN
 
     def record_success(self) -> None:
-        self._state = self.CLOSED
+        self._transition(self.CLOSED)
         self._failures = 0
 
     def record_failure(self) -> None:
@@ -216,6 +243,6 @@ class CircuitBreaker:
             self._trip()
 
     def _trip(self) -> None:
-        self._state = self.OPEN
+        self._transition(self.OPEN)
         self._failures = 0
         self._opened_at = self._clock()

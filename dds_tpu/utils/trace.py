@@ -1,20 +1,25 @@
-"""Structured tracing: per-phase timing events + counters.
+"""Structured tracing: causally-linked spans + point events + counters.
 
 The reference's only observability is three debug flags gating `println`s
 and a client ops/s printout (SURVEY.md §5.1, `dds-system.conf:61-62`,
 `clt/DDSHttpClient.scala:410-415`). This module is the structured upgrade
-called for there: every subsystem records named spans (HTTP route time,
-ABD quorum RTT, crypto kernel time) into a bounded in-memory ring that can
-be summarized (count/total/mean/p95) or dumped as JSONL for offline
-analysis. Overhead is one perf_counter pair and a deque append per span.
+called for there, extended by Telescope (dds_tpu/obs) into DISTRIBUTED
+tracing: every recorded span carries `(trace_id, span_id, parent_id)` from
+the contextvar-propagated `obs.context`, so one REST request yields a span
+tree — HTTP route -> quorum round -> per-replica handler -> crypto kernel —
+instead of an anonymous flat ring. Point `event`s (chaos injections, retry
+attempts, breaker transitions, attacks) annotate the same tree with zero
+duration. Overhead is one perf_counter pair and a deque append per span.
 
 Usage:
 
     from dds_tpu.utils.trace import tracer
-    with tracer.span("abd.fetch", key=key):
-        ...
+    with tracer.span("abd.fetch", key=key) as meta:
+        meta["coordinator"] = coord      # annotate mid-span
+    tracer.event("breaker.open", target=coord)
     tracer.count("abd.suspect")
-    print(tracer.summary())
+    print(tracer.summary())              # span stats only
+    print(tracer.counters())             # counters, separately
 """
 
 from __future__ import annotations
@@ -22,9 +27,14 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import math
+import os
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import Optional
+
+from dds_tpu.obs import context as obs_context
 
 
 @dataclass
@@ -33,6 +43,17 @@ class SpanRecord:
     name: str
     dur_ms: float
     meta: dict
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_id: Optional[str] = None
+    kind: str = "span"  # "span" (timed) | "event" (zero-duration annotation)
+
+
+def _percentile(sorted_durs: list[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending list (exact for small k:
+    p95 of 20 samples is the 19th value, not the max)."""
+    k = len(sorted_durs)
+    return sorted_durs[max(0, min(k - 1, math.ceil(q * k) - 1))]
 
 
 @dataclass
@@ -51,21 +72,50 @@ class Tracer:
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
-    def span(self, name: str, **meta):
+    def span(self, name: str, /, _ctx: Optional[obs_context.SpanContext] = None,
+             **meta):
+        """Timed span. Yields the (mutable) meta dict so callers can
+        annotate facts learned mid-span (the chosen coordinator, a batch
+        size). Installs a child trace context for the duration, so spans
+        recorded inside — including ones in tasks spawned inside (asyncio
+        copies contextvars at task creation) — become children."""
         if not self.enabled:
-            yield
+            yield meta
             return
+        ctx = _ctx if _ctx is not None else obs_context.child()
+        token = obs_context.attach(ctx)
         t0 = time.perf_counter()
         try:
-            yield
+            yield meta
         finally:
-            self.record(name, (time.perf_counter() - t0) * 1e3, **meta)
+            obs_context.detach(token)
+            self.record(name, (time.perf_counter() - t0) * 1e3, _ctx=ctx, **meta)
 
-    def record(self, name: str, dur_ms: float, **meta) -> None:
+    def record(self, name: str, dur_ms: float, /,
+               _ctx: Optional[obs_context.SpanContext] = None,
+               _kind: str = "span", **meta) -> None:
         if not self.enabled:
             return
+        ctx = _ctx if _ctx is not None else obs_context.current()
+        tid, sid, pid = (
+            (ctx.trace_id, ctx.span_id, ctx.parent_id) if ctx is not None
+            else (None, None, None)
+        )
         with self._lock:
-            self._events.append(SpanRecord(time.time(), name, dur_ms, meta))
+            self._events.append(
+                SpanRecord(time.time(), name, dur_ms, meta, tid, sid, pid, _kind)
+            )
+
+    def event(self, name: str, /, **meta) -> None:
+        """Zero-duration annotation attached to the ACTIVE trace (chaos
+        injections, retry attempts, breaker transitions, attacks). Outside
+        any trace the event is recorded unlinked rather than minting a
+        one-event orphan trace."""
+        if not self.enabled:
+            return
+        cur = obs_context.current()
+        ctx = obs_context.child(cur) if cur is not None else None
+        self.record(name, 0.0, _ctx=ctx, _kind="event", **meta)
 
     def count(self, name: str, n: int = 1) -> None:
         if not self.enabled:
@@ -80,15 +130,25 @@ class Tracer:
             evs = list(self._events)
         return [e for e in evs if name is None or e.name == name]
 
+    def trace_events(self, trace_id: str) -> list[SpanRecord]:
+        """All recorded spans/events of one trace, in record order."""
+        with self._lock:
+            evs = list(self._events)
+        return [e for e in evs if e.trace_id == trace_id]
+
     def counters(self) -> dict[str, int]:
         with self._lock:
             return dict(self._counters)
 
     def summary(self) -> dict[str, dict]:
-        """Per-span-name {count, total_ms, mean_ms, p50_ms, p95_ms}."""
+        """Per-span-name {count, total_ms, mean_ms, p50_ms, p95_ms} over
+        TIMED spans only. Counters are a different quantity (occurrences,
+        not durations) and zero-duration events would deflate the means —
+        both are reported separately (`counters()`, the /_trace route)."""
         groups: dict[str, list[float]] = collections.defaultdict(list)
         for e in self.events():
-            groups[e.name].append(e.dur_ms)
+            if e.kind == "span":
+                groups[e.name].append(e.dur_ms)
         out = {}
         for name, durs in sorted(groups.items()):
             durs.sort()
@@ -97,25 +157,30 @@ class Tracer:
                 "count": k,
                 "total_ms": round(sum(durs), 3),
                 "mean_ms": round(sum(durs) / k, 3),
-                "p50_ms": round(durs[k // 2], 3),
-                "p95_ms": round(durs[min(k - 1, int(k * 0.95))], 3),
+                "p50_ms": round(_percentile(durs, 0.50), 3),
+                "p95_ms": round(_percentile(durs, 0.95), 3),
             }
-        for name, n in self.counters().items():
-            out.setdefault(name, {})["count"] = (
-                out.get(name, {}).get("count", 0) + n
-            )
         return out
+
+    @staticmethod
+    def event_dict(e: SpanRecord) -> dict:
+        """One JSON-safe record. Meta lives under its own "meta" key so a
+        span recorded with meta named `name`/`ts`/`dur_ms` can never
+        shadow the record fields."""
+        rec = {"ts": e.ts, "name": e.name, "dur_ms": e.dur_ms, "kind": e.kind}
+        if e.trace_id is not None:
+            rec["trace_id"] = e.trace_id
+            rec["span_id"] = e.span_id
+            rec["parent_id"] = e.parent_id
+        if e.meta:
+            rec["meta"] = e.meta
+        return rec
 
     def dump_jsonl(self, path: str) -> int:
         evs = self.events()
         with open(path, "w") as f:
             for e in evs:
-                f.write(
-                    json.dumps(
-                        {"ts": e.ts, "name": e.name, "dur_ms": e.dur_ms, **e.meta}
-                    )
-                    + "\n"
-                )
+                f.write(json.dumps(self.event_dict(e), default=str) + "\n")
         return len(evs)
 
     def reset(self) -> None:
@@ -124,5 +189,18 @@ class Tracer:
             self._counters.clear()
 
 
+def _default_tracer() -> Tracer:
+    """Process-wide tracer, env-tunable: DDS_OBS_RING sizes the span ring
+    (default 65536), DDS_OBS_TRACE=0 disables recording entirely."""
+    try:
+        ring = int(os.environ.get("DDS_OBS_RING", "65536"))
+    except ValueError:
+        ring = 65536
+    enabled = os.environ.get("DDS_OBS_TRACE", "").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+    return Tracer(max_events=max(16, ring), enabled=enabled)
+
+
 # process-wide default tracer (subsystems import this)
-tracer = Tracer()
+tracer = _default_tracer()

@@ -30,6 +30,10 @@ class TrustedNodesList:
         if node in self._strikes:
             self._strikes[node] += 1
 
+    def suspicions(self) -> dict[str, int]:
+        """Current strike count per member (observability snapshot)."""
+        return dict(self._strikes)
+
     def get_untrusted(self) -> list[str]:
         return [n for n, s in self._strikes.items() if s >= STRIKE_LIMIT]
 
