@@ -230,6 +230,121 @@ class ActiveReplicas:
 
 
 # --------------------------------------------------------------------------
+# Aegis recovery plane: verified state transfer + Merkle anti-entropy
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class StateDigestRequest:
+    """Supervisor -> replica (or spare): send your signed state manifest.
+    Answered by healthy AND sentinent nodes — the supervisor cross-checks a
+    quorum of manifests before any recovery seeding, and ranks spares by
+    manifest freshness."""
+
+    nonce: int
+
+
+@dataclass(frozen=True)
+class StateDigest:
+    """Replica -> supervisor: manifest = {key: [tag.seq, tag.id,
+    value-digest]} over every tracked repository entry, HMAC-signed with
+    the signer address bound in (utils/sigs.manifest_signature)."""
+
+    manifest: dict
+    nonce: int
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class SleepBegin:
+    """Supervisor -> recovering node: verified-reseed header. `digests` is
+    the collected quorum of manifests, each `[signer, manifest, nonce,
+    signature-hex]`; the node re-verifies every HMAC and accepts a seeded
+    entry only when its (tag, value-digest) is attested by at least
+    `support` (= f+1) distinct signers. `total` StateChunk frames follow
+    (any order — transports reorder)."""
+
+    digests: list
+    session: int
+    total: int
+    support: int
+    nonces: list
+
+
+@dataclass(frozen=True)
+class StateChunk:
+    """One slice of the seeding state: {key: {"tag": [seq, id], "value":
+    set|None}}. Chunked so a large repository streams as bounded frames
+    instead of one giant Sleep (TcpNet.MAX_FRAME)."""
+
+    session: int
+    seq: int
+    entries: dict
+
+
+@dataclass(frozen=True)
+class MerkleRootRequest:
+    nonce: int
+
+
+@dataclass(frozen=True)
+class MerkleRoot:
+    """Anti-entropy phase 1 reply: root hash over the replica's (key ->
+    tag, value-digest) index + tracked-entry count, HMAC-signed."""
+
+    root: str
+    count: int
+    nonce: int
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class MerkleBucketRequest:
+    nonce: int
+
+
+@dataclass(frozen=True)
+class MerkleBuckets:
+    """Phase 2 reply: the per-bucket digest vector (hex per bucket)."""
+
+    digests: list
+    nonce: int
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class MerkleKeysRequest:
+    buckets: list
+    nonce: int
+
+
+@dataclass(frozen=True)
+class MerkleKeys:
+    """Phase 3 reply: {key: [seq, id, value-digest]} for the requested
+    divergent buckets — tags + digests only, values never travel here."""
+
+    entries: dict
+    nonce: int
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class RepairRequest:
+    keys: list
+    nonce: int
+
+
+@dataclass(frozen=True)
+class RepairReply:
+    """Phase 4 reply: {key: {"tag": [seq, id], "value": set|None, "sig":
+    hex}} where each sig is the standard ABD HMAC over (value, tag,
+    nonce) — the same authenticity bar as a protocol Write, validated
+    before store-if-newer."""
+
+    entries: dict
+    nonce: int
+
+
+# --------------------------------------------------------------------------
 # fault injection backdoor (malicious/MaliciousAttack.scala:34)
 # --------------------------------------------------------------------------
 
@@ -260,6 +375,9 @@ _TYPES = {
         Suspect, Awake, State, Sleep, Complying, Kill,
         Redeploy, Redeployed, RequestReplicas, ActiveReplicas, Compromise,
         Crash,
+        StateDigestRequest, StateDigest, SleepBegin, StateChunk,
+        MerkleRootRequest, MerkleRoot, MerkleBucketRequest, MerkleBuckets,
+        MerkleKeysRequest, MerkleKeys, RepairRequest, RepairReply,
     )
 }
 

@@ -28,7 +28,9 @@ import random
 from dataclasses import dataclass, field
 
 from dds_tpu.core import messages as M
+from dds_tpu.core.antientropy import AntiEntropy, MerkleIndex
 from dds_tpu.core.transport import Transport
+from dds_tpu.obs.flight import flight
 from dds_tpu.obs.metrics import metrics
 from dds_tpu.utils import sigs
 from dds_tpu.utils.trace import tracer
@@ -105,6 +107,17 @@ class BFTABDNode:
         # the per-key-set tag vector + its MAC inputs between repository
         # changes, making repeat ReadTagBatch rounds O(1) instead of O(K)
         self._tagbatch_cache: dict[tuple, tuple] = {}
+        # Aegis: incremental (key -> tag, value-digest) hash index — the
+        # source of StateDigest manifests and the anti-entropy tree
+        self.merkle = MerkleIndex()
+        # per-replica sync agent; run.launch (or a test) starts its loop
+        self.antientropy = AntiEntropy(self)
+        # verified-reseed sessions in flight: session -> {begin, chunks}
+        # (SleepBegin and StateChunks may arrive in any order)
+        self._recovery_sessions: dict[int, dict] = {}
+        # last snapshot save/load bookkeeping (core/snapshot fills it;
+        # exported via /health + scrape-time gauges)
+        self.snapshot_meta: dict = {}
         net.register(addr, self.handle)
 
     # ------------------------------------------------------------------ util
@@ -138,6 +151,15 @@ class BFTABDNode:
         tag-batch vectors (and their fingerprints) invalidate."""
         self.repository[key] = (tag, value)
         self.repo_version += 1
+        self.merkle.update(key, tag, value)
+
+    def _install_repository(self, repository: dict) -> None:
+        """Replace the whole repository (reseed / snapshot restore): bump
+        the version, drop memo caches, rebuild the Merkle index."""
+        self.repository = repository
+        self.repo_version += 1
+        self._tagbatch_cache.clear()
+        self.merkle.rebuild(repository)
 
     def _wipe(self) -> None:
         self.repository = {}
@@ -145,6 +167,8 @@ class BFTABDNode:
         self.incoming = {}
         self.repo_version += 1
         self._tagbatch_cache.clear()
+        self.merkle.rebuild({})
+        self._recovery_sessions.clear()
 
     def _tag_batch_fill(self, keys: tuple, digest: str) -> tuple[tuple, bytes]:
         """(tag vector, fingerprint) for an AUTHENTICATED ReadTagBatch,
@@ -463,17 +487,33 @@ class BFTABDNode:
                     self._broadcast(M.Write(max_tag, key, max_val, max_sig, nonce))
 
             case M.Sleep(data, nonces):
-                self.repository = {
+                # legacy unverified reseed (kept for deployments that turn
+                # verified_transfer off): the seeding state is trusted
+                # verbatim — the blind spot the SleepBegin path closes
+                self._install_repository({
                     k: (M.ABDTag(v["tag"][0], v["tag"][1]), v["value"])
                     for k, v in data.items()
-                }
-                self.repo_version += 1
-                self._tagbatch_cache.clear()
+                })
                 for n in nonces:
                     self.incoming[int(n)] = True
                 self._debug("going to sleep")
                 self._send(sender, M.Complying())
                 self.behavior = "sentinent"
+
+            case M.SleepBegin() | M.StateChunk():
+                self._recovery_ingest(sender, msg)
+
+            case M.StateDigestRequest(nonce):
+                manifest = self.merkle.manifest()
+                sig = sigs.manifest_signature(
+                    cfg.abd_mac_secret, self.addr, manifest, nonce
+                )
+                self._send(sender, M.StateDigest(manifest, nonce, sig))
+
+            case (M.MerkleRootRequest() | M.MerkleBucketRequest()
+                  | M.MerkleKeysRequest() | M.RepairRequest() | M.MerkleRoot()
+                  | M.MerkleBuckets() | M.MerkleKeys() | M.RepairReply()):
+                self.antientropy.handle(sender, msg)
 
             case M.Kill():
                 # guardian-restart semantics: fresh empty state, healthy
@@ -514,6 +554,22 @@ class BFTABDNode:
                 }
                 self._send(sender, M.State(data, list(self.incoming.keys())))
                 self.behavior = "healthy"
+
+            case M.StateDigestRequest(nonce):
+                # the supervisor's spare-freshness probe and the verified-
+                # transfer quorum both reach spares too
+                manifest = self.merkle.manifest()
+                sig = sigs.manifest_signature(
+                    cfg.abd_mac_secret, self.addr, manifest, nonce
+                )
+                self._send(sender, M.StateDigest(manifest, nonce, sig))
+
+            case (M.MerkleRootRequest() | M.MerkleBucketRequest()
+                  | M.MerkleKeysRequest() | M.RepairRequest() | M.MerkleRoot()
+                  | M.MerkleBuckets() | M.MerkleKeys() | M.RepairReply()):
+                # spares sync too: a snapshot-restored sentinent converges
+                # before it is ever promoted
+                self.antientropy.handle(sender, msg)
 
             case M.Kill():
                 self._wipe()
@@ -571,6 +627,113 @@ class BFTABDNode:
             case M.Kill():
                 self._wipe()
                 self.behavior = "healthy"
+
+    # ------------------------------------------------- verified state seed
+
+    MAX_RECOVERY_SESSIONS = 4
+
+    def _recovery_ingest(self, sender: str, msg) -> None:
+        """Buffer one frame of a verified reseed (SleepBegin header or a
+        StateChunk); transports reorder, so completion is by count, not
+        order. Sessions are bounded: a flood of bogus session ids evicts
+        oldest-first instead of growing without bound."""
+        sess = self._recovery_sessions.get(msg.session)
+        if sess is None:
+            while len(self._recovery_sessions) >= self.MAX_RECOVERY_SESSIONS:
+                self._recovery_sessions.pop(next(iter(self._recovery_sessions)))
+            sess = self._recovery_sessions[msg.session] = {
+                "begin": None, "sender": None, "chunks": {},
+            }
+        if isinstance(msg, M.SleepBegin):
+            sess["begin"] = msg
+            sess["sender"] = sender
+        else:
+            sess["chunks"][int(msg.seq)] = msg.entries
+        self._try_complete_recovery(msg.session)
+
+    def _try_complete_recovery(self, session: int) -> None:
+        sess = self._recovery_sessions.get(session)
+        begin = sess["begin"]
+        if begin is None:
+            return
+        chunks = sess["chunks"]
+        if sum(1 for s in chunks if 0 <= s < begin.total) < begin.total:
+            return
+        verified = self._verified_manifest(begin.digests, begin.support)
+        repository: dict[str, tuple] = {}
+        rejected: list[str] = []
+        for seq in range(begin.total):
+            for key, e in chunks[seq].items():
+                try:
+                    tag = M.ABDTag(int(e["tag"][0]), str(e["tag"][1]))
+                    value = e["value"]
+                except (KeyError, TypeError, ValueError, IndexError):
+                    rejected.append(key)
+                    continue
+                want = verified.get(key)
+                if want == (tag.seq, tag.id, sigs.value_digest(value)):
+                    repository[key] = (tag, value)
+                else:
+                    rejected.append(key)
+        self._recovery_sessions.pop(session, None)
+        self._install_repository(repository)
+        for n in begin.nonces:
+            self.incoming[int(n)] = True
+        if rejected:
+            log.warning(
+                "%s: verified reseed rejected %d/%d entries (digest quorum "
+                "mismatch) — anti-entropy will repair the holes",
+                self.name, len(rejected), len(rejected) + len(repository),
+            )
+            tracer.event("recovery.rejected_entries", replica=self.name,
+                         rejected=len(rejected), accepted=len(repository))
+            metrics.inc(
+                "dds_recovery_rejected_entries_total", len(rejected),
+                replica=self.name,
+                help="seeded entries rejected by the digest quorum",
+            )
+            flight.record(
+                "recovery_digest_mismatch", replica=self.name,
+                rejected=sorted(rejected)[:32], accepted=len(repository),
+            )
+        self._debug(
+            f"reseeded with {len(repository)} verified entries "
+            f"({len(rejected)} rejected); going to sleep"
+        )
+        self._send(sess["sender"], M.Complying())
+        self.behavior = "sentinent"
+
+    def _verified_manifest(self, digests: list, support: int) -> dict:
+        """Cross-check the relayed manifest quorum: verify every HMAC (the
+        signer address is bound into it, so a relay cannot re-attribute)
+        and keep only entries attested identically by >= `support` (= f+1)
+        distinct signers — at least one of which is then honest, so no
+        single Byzantine spare or relay can smuggle a forged entry."""
+        votes: dict[tuple, set] = {}
+        for item in digests:
+            try:
+                signer, manifest, nonce, sighex = item
+                if not sigs.validate_manifest_signature(
+                    self.cfg.abd_mac_secret, str(signer), manifest,
+                    int(nonce), bytes.fromhex(sighex),
+                ):
+                    continue
+            except (TypeError, ValueError):
+                continue
+            for key, ent in manifest.items():
+                try:
+                    attested = (str(key), int(ent[0]), str(ent[1]), str(ent[2]))
+                except (TypeError, ValueError, IndexError):
+                    continue
+                votes.setdefault(attested, set()).add(str(signer))
+        verified: dict[str, tuple] = {}
+        for (key, seq, tid, vd), signers in votes.items():
+            if len(signers) < support:
+                continue
+            cur = verified.get(key)
+            if cur is None or (seq, tid) > (cur[0], cur[1]):
+                verified[key] = (seq, tid, vd)
+        return verified
 
     # ---------------------------------------------------------------- admin
 

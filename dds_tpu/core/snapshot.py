@@ -1,68 +1,234 @@
-"""Optional snapshot-to-disk for replica state.
+"""Crash-safe authenticated snapshots (v2) for replica state.
 
 The reference has no disk persistence: durability comes from replication
 only, with live `State(data, nonces)` transfer re-seeding recovered nodes
 (SURVEY.md §5.4, `BFTABDNode.scala:368-375,413-416`). We keep that model
 — snapshots are an *additional* cold-start accelerator, not the source of
 truth: a restored replica rejoins with a possibly-stale repository and the
-ABD read/write-back protocol repairs it per-key (same argument as spare
-promotion).
+Merkle anti-entropy loop (core/antientropy.py) converges it without
+waiting for client reads.
 
-Format: one JSON file per replica: {"repository": {key: [seq, id, value]},
-"expired_nonces": [...]} — value is the JSON row (list) or null.
+v2 format — one file per generation, `{name}.snapshot.{gen:08d}.json`:
+
+    <canonical JSON body>\n<hmac-sha256 hex footer>\n
+
+    body = {"v": 2, "generation": g, "saved_at": unix-ts,
+            "repository": {key: [tag.seq, tag.id, value]},
+            "nonces": {str(nonce): expired_bool}}
+
+- The footer authenticates the body with a key derived (derive_secret)
+  from the intranet secret plus, when provisioned, the node's transport
+  key file (utils/nodeauth) — a snapshot forged or flipped on disk fails
+  verification at load and is QUARANTINED (renamed `*.corrupt`), never
+  loaded and never allowed to crash `run.launch`.
+- Writes are fsync-before-rename (file *and* directory), so a crash
+  mid-save leaves either the previous generation or the complete new one.
+- Generations rotate keep-N: load walks newest-first and falls back to
+  the next-older generation when one fails verification.
+- The FULL anti-replay nonce map persists (v1 kept only expired nonces,
+  silently dropping in-flight ones across a restore — a replay window).
+
+v1 files (`{name}.snapshot.json`, no footer) are still readable for
+migration — unauthenticated, with a loud warning; corrupt/truncated ones
+are quarantined as `{name}.snapshot.corrupt`.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
+import logging
 import os
 import pathlib
+import re
+import time
 
 from dds_tpu.core import messages as M
 from dds_tpu.core.replica import BFTABDNode
+from dds_tpu.obs.metrics import metrics
+
+log = logging.getLogger("dds.snapshot")
+
+# default derivation base = the default intranet secret, so bare
+# save_replica/load_replica calls (tests, tooling) stay self-consistent
+# with launch()-derived secrets under a default config
+DEFAULT_BASE = b"intranet-abd-secret"
+
+_GEN_RE = re.compile(r"\.snapshot\.(\d{8})\.json$")
 
 
-def save_replica(node: BFTABDNode, directory: str | os.PathLike) -> pathlib.Path:
-    """Write the node's repository + anti-replay state atomically."""
+def derive_secret(base: bytes = DEFAULT_BASE,
+                  node_key_path: str | os.PathLike | None = None) -> bytes:
+    """Snapshot MAC key: HMAC-derived from the intranet secret, mixed with
+    the node's transport key file (utils/nodeauth) when one is provisioned
+    — per-node keys then yield per-node snapshot keys, so one host's
+    snapshot cannot be replanted onto another."""
+    material = bytes(base)
+    if node_key_path:
+        p = pathlib.Path(node_key_path)
+        if p.exists():
+            material += p.read_bytes()
+    return hmac.new(material, b"dds-snapshot-mac-v2", hashlib.sha256).digest()
+
+
+def _generations(directory: pathlib.Path, name: str) -> list[tuple[int, pathlib.Path]]:
+    """(gen, path) for every v2 generation file of `name`, newest first."""
+    out = []
+    for p in directory.glob(f"{name}.snapshot.*.json"):
+        m = _GEN_RE.search(p.name)
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out, reverse=True)
+
+
+def _quarantine(path: pathlib.Path, reason: str, replica: str) -> None:
+    """Rename a bad snapshot aside (`*.corrupt`) instead of loading it or
+    letting its parse error abort boot."""
+    target = path.with_name(
+        path.name[:-len(".json")] + ".corrupt"
+        if path.name.endswith(".json") else path.name + ".corrupt"
+    )
+    log.warning("quarantining snapshot %s -> %s (%s)", path, target.name, reason)
+    metrics.inc(
+        "dds_snapshot_verify_failures_total", replica=replica,
+        help="snapshot files quarantined at load (corrupt/truncated/forged)",
+    )
+    try:
+        os.replace(path, target)
+    except OSError as e:  # pragma: no cover - fs-dependent
+        log.warning("could not quarantine %s: %s", path, e)
+
+
+def save_replica(node: BFTABDNode, directory: str | os.PathLike,
+                 secret: bytes | None = None, keep: int = 3) -> pathlib.Path:
+    """Write one authenticated generation of the node's state; prune to
+    the newest `keep` generations."""
+    secret = secret or derive_secret()
     d = pathlib.Path(directory)
     d.mkdir(parents=True, exist_ok=True)
-    path = d / f"{node.name}.snapshot.json"
+    gens = _generations(d, node.name)
+    gen = (gens[0][0] + 1) if gens else 1
     state = {
+        "v": 2,
+        "generation": gen,
+        "saved_at": time.time(),
         "repository": {
             k: [t.seq, t.id, v] for k, (t, v) in node.repository.items()
         },
-        "expired_nonces": sorted(
-            n for n, expired in node.incoming.items() if expired
-        ),
+        # the FULL anti-replay map: in-flight (unexpired) nonces must
+        # survive a restore or they become replayable
+        "nonces": {str(n): bool(e) for n, e in node.incoming.items()},
     }
-    tmp = path.with_suffix(".tmp")
-    tmp.write_text(json.dumps(state))
+    body = json.dumps(state, sort_keys=True, separators=(",", ":")).encode()
+    footer = hmac.new(secret, body, hashlib.sha256).hexdigest().encode()
+    path = d / f"{node.name}.snapshot.{gen:08d}.json"
+    tmp = d / (path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(body + b"\n" + footer + b"\n")
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    try:
+        # the rename itself must be durable, or a crash can resurface the
+        # old directory entry with the new data gone
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover - fs-dependent
+        pass
+    for _, old in _generations(d, node.name)[max(1, keep):]:
+        try:
+            old.unlink()
+        except OSError:  # pragma: no cover - fs-dependent
+            pass
+    node.snapshot_meta = {"generation": gen, "saved_at": state["saved_at"]}
+    metrics.set("dds_snapshot_generation", gen, replica=node.name,
+                help="latest snapshot generation written or loaded")
     return path
 
 
-def load_replica(node: BFTABDNode, directory: str | os.PathLike) -> bool:
-    """Restore a prior snapshot into the node, if one exists."""
-    path = pathlib.Path(directory) / f"{node.name}.snapshot.json"
-    if not path.exists():
-        return False
-    state = json.loads(path.read_text())
-    node.repository = {
-        k: (M.ABDTag(seq, tid), v)
+def _read_v2(path: pathlib.Path, secret: bytes) -> dict:
+    raw = path.read_bytes()
+    body, sep, footer = raw.rstrip(b"\n").rpartition(b"\n")
+    if not sep or not body:
+        raise ValueError("truncated (no footer)")
+    if not hmac.compare_digest(
+        hmac.new(secret, body, hashlib.sha256).hexdigest().encode(),
+        footer.strip(),
+    ):
+        raise ValueError("HMAC footer mismatch (corrupt or forged)")
+    state = json.loads(body)
+    if state.get("v") != 2:
+        raise ValueError(f"unsupported snapshot version {state.get('v')!r}")
+    return state
+
+
+def _install(node: BFTABDNode, state: dict, generation: int) -> None:
+    node._install_repository({
+        k: (M.ABDTag(int(seq), str(tid)), v)
         for k, (seq, tid, v) in (
             (k, tuple(entry)) for k, entry in state["repository"].items()
         )
-    }
-    for n in state.get("expired_nonces", []):
+    })
+    for n, expired in (state.get("nonces") or {}).items():
+        node.incoming[int(n)] = bool(expired)
+    for n in state.get("expired_nonces", []):  # v1 files
         node.incoming[int(n)] = True
-    return True
+    node.snapshot_meta = {
+        "generation": generation,
+        "saved_at": state.get("saved_at"),
+        "loaded": True,
+    }
+    metrics.set("dds_snapshot_generation", generation, replica=node.name,
+                help="latest snapshot generation written or loaded")
 
 
-def save_all(replicas: dict[str, BFTABDNode], directory: str | os.PathLike) -> int:
+def load_replica(node: BFTABDNode, directory: str | os.PathLike,
+                 secret: bytes | None = None) -> bool:
+    """Restore the newest VERIFIED snapshot generation, quarantining every
+    corrupt/truncated/forged file it walks past; never raises for bad
+    files, so one flipped byte cannot abort `run.launch`."""
+    secret = secret or derive_secret()
+    d = pathlib.Path(directory)
+    for gen, path in _generations(d, node.name):
+        try:
+            state = _read_v2(path, secret)
+        except (OSError, ValueError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            _quarantine(path, str(e), node.name)
+            continue
+        _install(node, state, gen)
+        return True
+    legacy = d / f"{node.name}.snapshot.json"
+    if legacy.exists():
+        try:
+            state = json.loads(legacy.read_text())
+            if not isinstance(state, dict) or "repository" not in state:
+                raise ValueError("not a v1 snapshot object")
+        except (OSError, ValueError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            _quarantine(legacy, str(e), node.name)
+            return False
+        log.warning(
+            "loaded UNAUTHENTICATED v1 snapshot %s; the next save upgrades "
+            "it to the authenticated v2 format", legacy,
+        )
+        _install(node, state, int(state.get("generation", 0)))
+        return True
+    return False
+
+
+def save_all(replicas: dict[str, BFTABDNode], directory: str | os.PathLike,
+             secret: bytes | None = None, keep: int = 3) -> int:
     for node in replicas.values():
-        save_replica(node, directory)
+        save_replica(node, directory, secret=secret, keep=keep)
     return len(replicas)
 
 
-def load_all(replicas: dict[str, BFTABDNode], directory: str | os.PathLike) -> int:
-    return sum(1 for node in replicas.values() if load_replica(node, directory))
+def load_all(replicas: dict[str, BFTABDNode], directory: str | os.PathLike,
+             secret: bytes | None = None) -> int:
+    return sum(
+        1 for node in replicas.values()
+        if load_replica(node, directory, secret=secret)
+    )

@@ -170,9 +170,15 @@ async def _cancel_task(task: asyncio.Task) -> None:
 
 
 class DDSRestServer:
-    def __init__(self, abd: AbdClient, config: ProxyConfig | None = None):
+    def __init__(self, abd: AbdClient, config: ProxyConfig | None = None,
+                 local_replicas: dict | None = None):
         self.abd = abd
         self.cfg = config or ProxyConfig()
+        # endpoint -> BFTABDNode for replicas hosted in THIS process (the
+        # live dict from run.launch — redeploys mutate it in place), so
+        # /health and /metrics can export the Aegis recovery surface:
+        # anti-entropy divergence/sync age and snapshot generation/age
+        self.local_replicas = local_replicas
         self.backend: CryptoBackend = get_backend(self.cfg.crypto_backend)
         self.stored_keys: set[str] = set()
         # key -> (tag, value): every entry comes from a COMPLETED quorum op
@@ -893,18 +899,19 @@ class DDSRestServer:
                     if n not in self.abd.breakers or self.abd.breakers[n].allow()
                 ]
                 degraded = len(reachable) < self.abd.cfg.quorum_size
-                resp = Response.json(
-                    {
-                        "status": "degraded" if degraded else "ok",
-                        "active_replicas": len(trusted),
-                        "reachable_replicas": len(reachable),
-                        "quorum_size": self.abd.cfg.quorum_size,
-                        "breakers": breakers,
-                        "stored_keys": len(self.stored_keys),
-                        "request_budget": self.cfg.request_budget,
-                    },
-                    status=503 if degraded else 200,
-                )
+                health = {
+                    "status": "degraded" if degraded else "ok",
+                    "active_replicas": len(trusted),
+                    "reachable_replicas": len(reachable),
+                    "quorum_size": self.abd.cfg.quorum_size,
+                    "breakers": breakers,
+                    "stored_keys": len(self.stored_keys),
+                    "request_budget": self.cfg.request_budget,
+                }
+                recovery = self._recovery_status()
+                if recovery is not None:
+                    health["recovery"] = recovery
+                resp = Response.json(health, status=503 if degraded else 200)
                 if degraded:
                     resp.headers["Retry-After"] = str(
                         max(1, round(self.cfg.retry_after_hint))
@@ -962,6 +969,67 @@ class DDSRestServer:
         )
         metrics.set("dds_stored_keys", len(self.stored_keys),
                     help="aggregate key-set size")
+        # Aegis recovery surface (local replicas only): anti-entropy
+        # divergence + sync age, snapshot generation + age
+        for node in (self.local_replicas or {}).values():
+            stats = node.antientropy.stats()
+            metrics.set(
+                "dds_antientropy_divergent_buckets",
+                stats["divergent_buckets"], replica=node.name,
+                help="divergent Merkle buckets seen in the last sync round",
+            )
+            if stats["last_sync_age"] is not None:
+                metrics.set(
+                    "dds_antientropy_last_sync_age_seconds",
+                    stats["last_sync_age"], replica=node.name,
+                    help="seconds since the last completed anti-entropy round",
+                )
+            sm = node.snapshot_meta
+            if sm.get("generation") is not None:
+                metrics.set(
+                    "dds_snapshot_generation", sm["generation"],
+                    replica=node.name,
+                    help="latest snapshot generation written or loaded",
+                )
+            if sm.get("saved_at"):
+                metrics.set(
+                    "dds_snapshot_age_seconds",
+                    max(0.0, time.time() - sm["saved_at"]), replica=node.name,
+                    help="seconds since this replica's snapshot was written",
+                )
+
+    def _recovery_status(self) -> dict | None:
+        """Per-local-replica Aegis view for /health: anti-entropy sync
+        state and snapshot durability state."""
+        if not self.local_replicas:
+            return None
+        out = {}
+        for node in self.local_replicas.values():
+            stats = node.antientropy.stats()
+            sm = node.snapshot_meta
+            out[node.name] = {
+                "merkle_root": node.merkle.root()[:16],
+                "tracked_keys": len(node.merkle),
+                "anti_entropy": {
+                    "rounds": stats["rounds"],
+                    "repaired_keys": stats["repaired_keys"],
+                    "divergent_buckets": stats["divergent_buckets"],
+                    "last_sync_age": stats["last_sync_age"],
+                    "running": stats["running"],
+                },
+                "snapshot": {
+                    "generation": sm.get("generation"),
+                    "age": (
+                        max(0.0, round(time.time() - sm["saved_at"], 3))
+                        if sm.get("saved_at") else None
+                    ),
+                    "verify_failures": metrics.value(
+                        "dds_snapshot_verify_failures_total",
+                        replica=node.name,
+                    ) or 0,
+                },
+            }
+        return out
 
     # ----------------------------------------------------- aggregate helpers
 

@@ -212,17 +212,38 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
         if e in replicas:
             replicas[e].behavior = "sentinent"  # Main.scala:96-98
 
-    # optional snapshot restore + periodic save (core/snapshot.py)
+    # optional snapshot restore + periodic save (core/snapshot.py v2:
+    # authenticated generations; corrupt/forged files are quarantined by
+    # load_all, never allowed to abort this boot)
+    snap_secret = None
     if cfg.recovery.snapshot_dir:
         from dds_tpu.core import snapshot as snap
 
-        restored = snap.load_all(replicas, cfg.recovery.snapshot_dir)
+        snap_secret = snap.derive_secret(
+            (cfg.recovery.snapshot_secret or cfg.security.abd_mac_secret).encode(),
+            cfg.security.node_key_path or None,
+        )
+        restored = snap.load_all(
+            replicas, cfg.recovery.snapshot_dir, secret=snap_secret
+        )
         if restored:
             log.info("restored %d replica snapshots from %s", restored,
                      cfg.recovery.snapshot_dir)
 
+    def _start_antientropy(node: BFTABDNode) -> None:
+        node.antientropy.configure(
+            interval=cfg.recovery.anti_entropy_interval,
+            jitter=cfg.recovery.anti_entropy_jitter,
+        )
+        node.antientropy.start()
+
     def _rebuild_local(endpoint: str) -> None:
+        old = replicas.get(endpoint)
+        if old is not None:
+            old.antientropy.cancel()  # the replaced node's loop must die
         replicas[endpoint] = BFTABDNode(endpoint, endpoints, sup_addr, net, rcfg)
+        if cfg.recovery.anti_entropy_enabled:
+            _start_antientropy(replicas[endpoint])
 
     # per-host node agent: honors the supervisor's Redeploy for replicas
     # THIS process owns — the `Main` process is what re-instantiates
@@ -293,6 +314,10 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
                 sentinent_awake_timeout=cfg.recovery.sentinent_awake_timeout,
                 crashed_recovery_timeout=cfg.recovery.crashed_recovery_timeout,
                 proactive_recovery_enabled=cfg.recovery.enabled,
+                verified_transfer=cfg.recovery.verified_transfer,
+                manifest_timeout=cfg.recovery.manifest_timeout,
+                state_chunk_keys=cfg.recovery.state_chunk_keys,
+                abd_mac_secret=cfg.security.abd_mac_secret.encode(),
                 debug=cfg.debug,
             ),
             redeploy=redeploy,
@@ -337,8 +362,22 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),
+        local_replicas=replicas,
     )
     await server.start()
+
+    # Merkle anti-entropy loops: one pull agent per local replica, on a
+    # jittered timer so the fleet's rounds spread out instead of thundering
+    if cfg.recovery.anti_entropy_enabled:
+        for node in replicas.values():
+            _start_antientropy(node)
+
+        class _AntiEntropyStopper:
+            async def stop(self):
+                for node in replicas.values():
+                    await node.antientropy.stop()
+
+        stoppables.append(_AntiEntropyStopper())
 
     if cfg.attacks.chaos_enabled:
         from dds_tpu.malicious.trudy import Nemesis
@@ -360,7 +399,9 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
                 # off-loop: serializing large repositories must not stall
                 # ABD handling or recovery timers
                 await asyncio.to_thread(
-                    snap.save_all, dict(dep.replicas), cfg.recovery.snapshot_dir
+                    snap.save_all, dict(dep.replicas),
+                    cfg.recovery.snapshot_dir,
+                    snap_secret, cfg.recovery.snapshot_keep,
                 )
 
         task = asyncio.ensure_future(_snapshot_loop())

@@ -83,6 +83,26 @@ class RecoveryConfig:
     # of truth; snapshots only warm cold starts). 0 disables.
     snapshot_dir: str = ""
     snapshot_interval: float = 0.0
+    # snapshot v2 (core/snapshot.py): generations kept per replica, and an
+    # optional explicit MAC-key base for the authenticated footer (empty =
+    # derived from security.abd_mac_secret + the node key file when
+    # security.node_key_path is provisioned)
+    snapshot_keep: int = 3
+    snapshot_secret: str = ""
+    # Aegis verified state transfer (core/supervisor.py): recovery seeds
+    # are cross-checked against a quorum of HMAC-signed state manifests;
+    # the recovering node accepts only entries attested by >= f+1 distinct
+    # signers. Off = the reference's single-spare trust.
+    verified_transfer: bool = True
+    manifest_timeout: float = 2.0
+    state_chunk_keys: int = 256
+    # Merkle anti-entropy (core/antientropy.py): every local replica runs
+    # a background pull loop on a jittered timer, so healed partitions,
+    # snapshot-restored rejoiners, and post-reseed holes converge without
+    # waiting for client reads
+    anti_entropy_enabled: bool = True
+    anti_entropy_interval: float = 5.0
+    anti_entropy_jitter: float = 2.0
 
 
 @dataclass

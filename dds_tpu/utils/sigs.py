@@ -113,6 +113,47 @@ def validate_abd_batch_unchanged_signature(
     )
 
 
+def value_digest(value) -> str:
+    """sha256 hex of a stored set's canonical form — the per-entry content
+    commitment behind verified state transfer and Merkle anti-entropy. A
+    manifest can attest a repository without shipping values; a seeded
+    value is accepted only if it hashes back to the attested digest."""
+    return hashlib.sha256(canonical(value).encode()).hexdigest()
+
+
+def manifest_signature(secret: bytes, signer: str, manifest: dict, nonce: int) -> bytes:
+    """Replica signature over its (key -> [seq, id, value-digest]) state
+    manifest. Binds the SIGNER address so a relay (the supervisor forwards
+    collected manifests to the recovering node) cannot re-attribute one
+    replica's manifest to another when distinct signers are counted."""
+    content = f"state-digest|{signer}|{canonical(manifest)}|{nonce}".encode()
+    return _mac(secret, content)
+
+
+def validate_manifest_signature(
+    secret: bytes, signer: str, manifest: dict, nonce: int, given: bytes
+) -> bool:
+    return hmac.compare_digest(
+        manifest_signature(secret, signer, manifest, nonce), given
+    )
+
+
+def antientropy_signature(secret: bytes, kind: str, payload, nonce: int) -> bytes:
+    """Intranet signature over one anti-entropy reply (root / bucket vector /
+    key listing). `kind` namespaces the phase so a captured reply of one
+    phase cannot be replayed as another's."""
+    content = f"ae-{kind}|{canonical(payload)}|{nonce}".encode()
+    return _mac(secret, content)
+
+
+def validate_antientropy_signature(
+    secret: bytes, kind: str, payload, nonce: int, given: bytes
+) -> bool:
+    return hmac.compare_digest(
+        antientropy_signature(secret, kind, payload, nonce), given
+    )
+
+
 _NO_VALUE = object()
 
 

@@ -1,0 +1,526 @@
+"""Aegis recovery-plane tests: Byzantine-verified state transfer, Merkle
+anti-entropy convergence, and crash-safe authenticated snapshots — all
+exercised under seeded ChaosNet schedules where the scenario calls for an
+adversarial network.
+
+Acceptance paths (ISSUE 3):
+- a recovered replica seeded by a Byzantine spare holds ZERO forged
+  entries (the digest quorum rejects them);
+- a snapshot file flipped by one byte is quarantined at boot, never
+  loaded and never allowed to crash run.launch;
+- anti-entropy converges a stale rejoined replica to the quorum state
+  without any client reads.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from dds_tpu.core import messages as M
+from dds_tpu.core.antientropy import MerkleIndex
+from dds_tpu.core.chaos import ChaosNet, LinkFaults
+from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
+from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
+from dds_tpu.core.supervisor import BFTSupervisor, SupervisorConfig
+from dds_tpu.core.transport import InMemoryNet
+from dds_tpu.utils import sigs
+from dds_tpu.utils.trace import tracer
+
+pytestmark = pytest.mark.recovery
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class Cluster:
+    """In-process cluster with optional seeded ChaosNet fabric."""
+
+    def __init__(self, n_active=7, n_sentinent=2, quorum=5, chaos_seed=None,
+                 awake_timeout=0.5, crashed_timeout=1.0):
+        inner = InMemoryNet()
+        self.chaos = None
+        if chaos_seed is not None:
+            self.chaos = ChaosNet(inner, seed=chaos_seed)
+            self.net = self.chaos
+        else:
+            self.net = inner
+        self.rcfg = ReplicaConfig(quorum_size=quorum)
+        all_addrs = [f"replica-{i}" for i in range(n_active + n_sentinent)]
+        self.active = all_addrs[:n_active]
+        self.sentinent = all_addrs[n_active:]
+        self.replicas = {
+            a: BFTABDNode(a, all_addrs, "supervisor", self.net, self.rcfg)
+            for a in all_addrs
+        }
+        for a in self.sentinent:
+            self.replicas[a].behavior = "sentinent"
+        self.supervisor = BFTSupervisor(
+            "supervisor",
+            self.active,
+            self.sentinent,
+            self.net,
+            SupervisorConfig(
+                quorum_size=quorum,
+                proactive_recovery_enabled=False,
+                sentinent_awake_timeout=awake_timeout,
+                crashed_recovery_timeout=crashed_timeout,
+                manifest_timeout=1.0,
+            ),
+            redeploy=self._redeploy,
+            rng=random.Random(3),
+        )
+        self.client = AbdClient(
+            "proxy-0", self.net, self.active,
+            AbdClientConfig(request_timeout=1.0),
+        )
+        self.client.replicas._rng = random.Random(7)
+
+    async def _redeploy(self, endpoint):
+        self.replicas[endpoint] = BFTABDNode(
+            endpoint, list(self.replicas), "supervisor", self.net, self.rcfg
+        )
+
+    async def quiesce(self):
+        await self.net.quiesce()
+
+    async def write(self, value):
+        key = sigs.key_from_set(value)
+        await self.client.write_set(key, value)
+        return key
+
+    def poison_spare(self, spare_name, real_key=None):
+        """Make a spare's State forged: a fabricated high-tag key (also
+        inflating its freshness rank so it WILL be chosen as seeder) plus,
+        when given, a tampered value under a real key's true tag."""
+        spare = self.replicas[spare_name]
+        spare._store("FORGED-KEY", M.ABDTag(1 << 20, "trudy"), ["evil", 666])
+        if real_key is not None and real_key in spare.repository:
+            tag, _ = spare.repository[real_key]
+            spare._store(real_key, tag, ["tampered"])
+
+
+def honest_state(cluster, replicas=None):
+    """{key: (tag, value)} attested identically by a majority of the given
+    replicas — the ground truth a recovered node must converge to."""
+    from collections import Counter
+
+    names = replicas or cluster.active
+    votes = Counter()
+    for name in names:
+        node = cluster.replicas[name]
+        for k, (t, v) in node.repository.items():
+            if MerkleIndex._tracked(t, v):
+                votes[(k, t, sigs.canonical(v))] += 1
+    out = {}
+    for (k, t, cv), n in votes.items():
+        if n > len(names) // 2:
+            out[k] = (t, cv)
+    return out
+
+
+# --------------------------------------------------- verified state transfer
+
+
+def test_byzantine_spare_forged_state_rejected_under_chaos():
+    """Acceptance: one Byzantine spare serves a forged State during
+    recovery under a seeded ChaosNet schedule; the recovered replica's
+    repository contains zero forged entries."""
+
+    async def go():
+        c = Cluster(chaos_seed=42)
+        # mild asymmetric jitter on a few quorum legs: the schedule is
+        # active (trace non-empty) but deliveries all complete
+        c.chaos.set_dest("replica-3", LinkFaults(delay=0.002, jitter=0.003))
+        c.chaos.set_dest("replica-5", LinkFaults(delay=0.001, jitter=0.002))
+        keys = [await c.write([i, f"row-{i}"]) for i in range(6)]
+        await c.quiesce()
+        # replica-8 is Byzantine: forged key + tampered value under a real
+        # tag; its inflated tag seq also makes it the freshest-ranked spare
+        c.poison_spare("replica-8", real_key=keys[0])
+        await c.supervisor.recover("replica-0")
+        await c.quiesce()
+        r0 = c.replicas["replica-0"]
+        assert r0.behavior == "sentinent"
+        # the forged entry and the tampered value are both rejected
+        assert "FORGED-KEY" not in r0.repository
+        got = r0.repository.get(keys[0], (None, None))[1]
+        assert got != ["tampered"]
+        # nothing in the recovered repository deviates from the honest
+        # majority state: zero poisoned keys
+        truth = honest_state(c)
+        for k, (t, v) in r0.repository.items():
+            if MerkleIndex._tracked(t, v):
+                assert k in truth and truth[k][1] == sigs.canonical(v)
+        assert len(c.chaos.trace) > 0  # the chaos schedule actually ran
+
+    run(go())
+
+
+def test_verified_transfer_streams_chunks():
+    """A repository larger than state_chunk_keys streams as multiple
+    StateChunk frames and still reseeds byte-identically."""
+
+    async def go():
+        c = Cluster()
+        c.supervisor.cfg.state_chunk_keys = 4
+        keys = [await c.write([i, "v"]) for i in range(11)]
+        await c.quiesce()
+        await c.supervisor.recover("replica-0")
+        await c.quiesce()
+        r0 = c.replicas["replica-0"]
+        for k in keys:
+            assert r0.repository.get(k, (None, None))[1] == \
+                c.replicas["replica-1"].repository[k][1]
+
+    run(go())
+
+
+def test_freshest_spare_preferred_and_seeder_traced():
+    """Satellite: the supervisor seeds from the spare with the freshest
+    repository (not a random one), and records the chosen seeder in the
+    recovery trace span."""
+
+    async def go():
+        c = Cluster()
+        key = await c.write([1, "x"])
+        await c.quiesce()
+        # replica-7 is stale (wiped); replica-8 observed the write
+        c.replicas["replica-7"]._install_repository({})
+        assert c.replicas["replica-8"].repository  # sanity: spare has data
+        await c.supervisor.recover("replica-0")
+        await c.quiesce()
+        active = [a for a, _ in c.supervisor.active]
+        assert "replica-8" in active        # freshest spare promoted
+        assert "replica-7" not in active    # stale spare left alone
+        seeders = [e for e in tracer.events("supervisor.seeder")
+                   if e.meta.get("victim") == "replica-0"]
+        assert seeders and seeders[-1].meta["seeder"] == "replica-8"
+
+    run(go())
+
+
+def test_verified_transfer_off_falls_back_to_legacy_sleep():
+    async def go():
+        c = Cluster()
+        c.supervisor.cfg.verified_transfer = False
+        key = await c.write([9, "legacy"])
+        await c.quiesce()
+        await c.supervisor.recover("replica-0")
+        await c.quiesce()
+        assert c.replicas["replica-0"].repository[key][1] == [9, "legacy"]
+        assert c.replicas["replica-0"].behavior == "sentinent"
+
+    run(go())
+
+
+# ------------------------------------------------------- Merkle anti-entropy
+
+
+def test_merkle_index_incremental_matches_rebuild():
+    rng = random.Random(5)
+    idx = MerkleIndex()
+    repo = {}
+    for step in range(300):
+        k = f"key-{rng.randrange(40)}"
+        if rng.random() < 0.15 and k in repo:
+            # a delete is a None write under a REAL tag: stays tracked
+            tag = M.ABDTag(repo[k][0].seq + 1, "r1")
+            repo[k] = (tag, None)
+        else:
+            tag = M.ABDTag(rng.randrange(1, 1000), f"r{rng.randrange(3)}")
+            repo[k] = (tag, [rng.randrange(100), "v"])
+        idx.update(k, *repo[k])
+    fresh = MerkleIndex()
+    fresh.rebuild(repo)
+    assert idx.root() == fresh.root()
+    assert idx.bucket_digests() == fresh.bucket_digests()
+    # the implicit _state() default is excluded from tracking
+    idx.update("phantom", M.ABDTag(0, "r0"), None)
+    assert idx.root() == fresh.root()
+
+
+def test_antientropy_converges_stale_rejoined_replica_without_reads():
+    """Acceptance: a stale rejoined replica converges to the quorum state
+    through anti-entropy alone — no client read ever touches the keys."""
+
+    async def go():
+        c = Cluster()
+        keys = [await c.write([i, f"data-{i}"]) for i in range(12)]
+        await c.quiesce()
+        stale = c.replicas["replica-1"]
+        stale._install_repository({})  # snapshot-restored-from-nothing rejoiner
+        peer = c.replicas["replica-2"]
+        assert stale.merkle.root() != peer.merkle.root()
+        repaired = 0
+        for _ in range(3):  # bounded rounds; one should suffice
+            repaired += await stale.antientropy.sync_once("replica-2")
+            if stale.merkle.root() == peer.merkle.root():
+                break
+        assert repaired == len(keys)
+        # byte-identical convergence: same tags, same values
+        assert stale.merkle.root() == peer.merkle.root()
+        for k in keys:
+            assert stale.repository[k] == peer.repository[k]
+
+    run(go())
+
+
+def test_antientropy_in_sync_round_is_cheap_and_counted():
+    async def go():
+        c = Cluster()
+        await c.write([1, "a"])
+        await c.quiesce()
+        node = c.replicas["replica-0"]
+        assert await node.antientropy.sync_once("replica-1") == 0
+        stats = node.antientropy.stats()
+        assert stats["rounds"] == 1 and stats["divergent_buckets"] == 0
+        assert stats["last_sync_age"] is not None
+
+    run(go())
+
+
+def test_recovery_under_chaos_partition_then_antientropy_convergence():
+    """The end-to-end schedule: partition + crash mid-workload under a
+    seeded ChaosNet, Byzantine spare, verified re-seed, heal, anti-entropy
+    — the recovered replica converges to the quorum state with zero
+    poisoned keys and no client reads after the heal."""
+
+    async def go():
+        c = Cluster(chaos_seed=1234, awake_timeout=0.3, crashed_timeout=1.0)
+        keys = [await c.write([i, "pre"]) for i in range(4)]
+        await c.quiesce()
+        # partition one active replica away mid-workload (5 reachable = q)
+        part = c.chaos.partition(["replica-6"])
+        # crash the victim (goes silent, like a Trudy crash)
+        c.net.send("trudy", "replica-0", M.Crash())
+        await c.quiesce()
+        # workload continues against the damaged cluster; a draw of the
+        # crashed coordinator times out, so retry like the proxy would
+        from dds_tpu.core.errors import ByzantineError
+
+        for i in range(4, 7):
+            value = [i, "mid"]
+            for _ in range(8):
+                try:
+                    keys.append(await c.write(value))
+                    break
+                except (ByzantineError, asyncio.TimeoutError):
+                    continue
+            else:
+                raise AssertionError("quorum never completed mid-partition")
+        await c.quiesce()
+        # Byzantine spare ready to poison the recovery seed
+        c.poison_spare("replica-8", real_key=keys[0])
+        # suspicion quorum -> recovery (crashed path: redeploy + reseed)
+        for i in range(1, 6):
+            c.net.send(f"replica-{i}", "supervisor",
+                       M.Suspect("replica-0", sigs.generate_nonce()))
+        for _ in range(60):
+            await asyncio.sleep(0.05)
+            await c.quiesce()
+            if "replica-0" in c.supervisor.sentinent:
+                break
+        assert "replica-0" in c.supervisor.sentinent
+        r0 = c.replicas["replica-0"]
+        assert "FORGED-KEY" not in r0.repository  # zero forged entries
+
+        # heal the partition; no client reads from here on
+        part.heal()
+        truth = honest_state(c, ["replica-1", "replica-2", "replica-3",
+                                 "replica-4", "replica-5"])
+        for node_name in ("replica-0", "replica-6"):
+            node = c.replicas[node_name]
+            for peer in ("replica-1", "replica-2", "replica-3"):
+                await node.antientropy.sync_once(peer)
+                await c.quiesce()
+        # every written key converges byte-identically on the rejoiners
+        for node_name in ("replica-0", "replica-6"):
+            node = c.replicas[node_name]
+            for k in keys:
+                assert k in truth
+                tag, cv = truth[k]
+                assert node.repository.get(k, (None, None))[0] == tag
+                assert sigs.canonical(node.repository[k][1]) == cv
+            # and zero poisoned keys anywhere in the repository
+            for k, (t, v) in node.repository.items():
+                if MerkleIndex._tracked(t, v):
+                    assert truth.get(k, (None, None))[1] == sigs.canonical(v)
+
+    run(go())
+
+
+# ------------------------------------------------- crash-safe snapshots (v2)
+
+
+def _node(name="r0", quorum=1):
+    return BFTABDNode(name, [name, "r1"], "sup", InMemoryNet(),
+                      ReplicaConfig(quorum_size=quorum))
+
+
+def test_snapshot_v2_roundtrip_preserves_inflight_nonces(tmp_path):
+    """Satellite: the FULL anti-replay map survives the round trip — an
+    in-flight (unexpired) nonce must not become replayable after restore."""
+    from dds_tpu.core import snapshot as snap
+
+    node = _node()
+    node._store("k", M.ABDTag(2, "r0"), [1, 2])
+    node.incoming[111] = False   # in-flight
+    node.incoming[222] = True    # expired
+    snap.save_replica(node, tmp_path)
+    fresh = _node()
+    assert snap.load_replica(fresh, tmp_path)
+    assert fresh.incoming[111] is False
+    assert fresh.incoming[222] is True
+    assert fresh.repository["k"] == (M.ABDTag(2, "r0"), [1, 2])
+    assert fresh.merkle.root() == node.merkle.root()  # index rebuilt on load
+    assert fresh.snapshot_meta["generation"] == 1
+
+
+def test_snapshot_bitflip_quarantined_falls_back_to_older_generation(tmp_path):
+    """Acceptance: one flipped byte -> the file is quarantined (renamed
+    aside), never loaded; the next-older verified generation restores."""
+    from dds_tpu.core import snapshot as snap
+
+    node = _node()
+    node._store("k1", M.ABDTag(1, "r0"), ["gen1"])
+    snap.save_replica(node, tmp_path)
+    node._store("k1", M.ABDTag(2, "r0"), ["gen2"])
+    p2 = snap.save_replica(node, tmp_path)
+    raw = bytearray(p2.read_bytes())
+    raw[len(raw) // 2] ^= 0x01
+    p2.write_bytes(bytes(raw))
+    fresh = _node()
+    assert snap.load_replica(fresh, tmp_path)
+    assert fresh.repository["k1"][1] == ["gen1"]  # older generation won
+    corrupt = list(tmp_path.glob("*.corrupt"))
+    assert len(corrupt) == 1 and "00000002" in corrupt[0].name
+    assert not any("00000002" in p.name for p in tmp_path.glob("*.json"))
+
+
+def test_snapshot_forged_footer_rejected(tmp_path):
+    from dds_tpu.core import snapshot as snap
+
+    node = _node()
+    node._store("k", M.ABDTag(1, "r0"), ["secret-keyed"])
+    snap.save_replica(node, tmp_path, secret=b"key-A")
+    fresh = _node()
+    # an attacker without the snapshot key cannot plant a loadable file
+    assert not snap.load_replica(fresh, tmp_path, secret=b"key-B")
+    assert not fresh.repository
+    assert list(tmp_path.glob("*.corrupt"))
+
+
+def test_snapshot_rotation_keeps_n_generations(tmp_path):
+    from dds_tpu.core import snapshot as snap
+
+    node = _node()
+    for i in range(6):
+        node._store("k", M.ABDTag(i + 1, "r0"), [i])
+        snap.save_replica(node, tmp_path, keep=2)
+    gens = sorted(p.name for p in tmp_path.glob("*.json"))
+    assert gens == ["r0.snapshot.00000005.json", "r0.snapshot.00000006.json"]
+
+
+def test_corrupt_legacy_snapshot_quarantined_not_crashing(tmp_path):
+    """Satellite: corrupt/truncated v1 JSON is treated as missing — warned
+    and quarantined as `<name>.snapshot.corrupt`, never raised."""
+    from dds_tpu.core import snapshot as snap
+
+    (tmp_path / "r0.snapshot.json").write_text('{"repository": {truncated')
+    fresh = _node()
+    assert not snap.load_replica(fresh, tmp_path)
+    assert (tmp_path / "r0.snapshot.corrupt").exists()
+    assert not (tmp_path / "r0.snapshot.json").exists()
+
+
+def test_corrupt_snapshots_do_not_abort_launch(tmp_path):
+    """Acceptance at BOOT: run.launch with a snapshot dir full of corrupt
+    files (flipped v2 + garbage v1) boots cleanly and quarantines both."""
+
+    async def go():
+        from dds_tpu.core import snapshot as snap
+        from dds_tpu.run import launch
+        from dds_tpu.utils.config import DDSConfig
+
+        cfg = DDSConfig()
+        cfg.proxy.port = 0
+        cfg.recovery.enabled = False
+        cfg.recovery.snapshot_dir = str(tmp_path)
+        cfg.recovery.anti_entropy_enabled = False
+
+        # a valid v2 file for replica-0, then flip one byte
+        node = BFTABDNode("replica-0", ["replica-0"], "sup", InMemoryNet(),
+                          ReplicaConfig())
+        node._store("k", M.ABDTag(3, "replica-0"), ["payload"])
+        secret = snap.derive_secret(cfg.security.abd_mac_secret.encode())
+        p = snap.save_replica(node, tmp_path, secret=secret)
+        raw = bytearray(p.read_bytes())
+        raw[10] ^= 0x01
+        p.write_bytes(bytes(raw))
+        # garbage v1 for replica-1
+        (tmp_path / "replica-1.snapshot.json").write_text("not json at all")
+
+        dep = await launch(cfg)
+        try:
+            r0 = dep.replicas["replica-0"]
+            assert "k" not in r0.repository          # forged file NOT loaded
+            assert list(tmp_path.glob("*.corrupt"))  # both quarantined
+            assert (tmp_path / "replica-1.snapshot.corrupt").exists()
+        finally:
+            await dep.stop()
+
+    run(go())
+
+
+# ------------------------------------------------------ observability surface
+
+
+def test_health_and_metrics_expose_recovery_gauges(tmp_path):
+    """Satellite: /health grows an Aegis recovery section and /metrics the
+    anti-entropy + snapshot gauge families."""
+
+    async def go():
+        from dds_tpu.core import snapshot as snap
+        from dds_tpu.http.miniserver import http_request
+        from dds_tpu.run import launch
+        from dds_tpu.utils.config import DDSConfig
+        import json as _json
+
+        cfg = DDSConfig()
+        cfg.proxy.port = 0
+        cfg.recovery.enabled = False
+        cfg.recovery.snapshot_dir = str(tmp_path)
+        cfg.recovery.anti_entropy_interval = 30.0  # loop exists, won't fire
+        dep = await launch(cfg)
+        try:
+            secret = snap.derive_secret(cfg.security.abd_mac_secret.encode())
+            snap.save_all(dep.replicas, tmp_path, secret=secret)
+            # one sync round so last_sync_age is populated
+            node = dep.replicas["replica-0"]
+            await node.antientropy.sync_once("replica-1")
+            host, port = cfg.proxy.host, dep.server.cfg.port
+            status, body = await http_request(host, port, "GET", "/health")
+            assert status == 200
+            health = _json.loads(body)
+            rec = health["recovery"]
+            assert rec["replica-0"]["anti_entropy"]["rounds"] >= 1
+            assert rec["replica-0"]["anti_entropy"]["last_sync_age"] is not None
+            assert rec["replica-0"]["anti_entropy"]["running"] is True
+            assert rec["replica-0"]["snapshot"]["generation"] == 1
+            assert rec["replica-0"]["snapshot"]["age"] is not None
+            # the counter is process-global (other tests may have bumped
+            # it); here it only needs to be present and numeric
+            assert rec["replica-0"]["snapshot"]["verify_failures"] >= 0
+            status, body = await http_request(host, port, "GET", "/metrics")
+            assert status == 200
+            text = body.decode()
+            assert "dds_antientropy_divergent_buckets" in text
+            assert "dds_antientropy_last_sync_age_seconds" in text
+            assert "dds_snapshot_generation" in text
+            assert "dds_snapshot_age_seconds" in text
+        finally:
+            await dep.stop()
+
+    run(go())
