@@ -197,39 +197,88 @@ def _run_sub(cmd: list[str], timeout_s: float) -> tuple[int | None, str, str]:
         return None, out, err
 
 
-def _probe_device(timeout_s: float) -> tuple[bool, str]:
+def _failure_tail(out: str, err: str, limit: int = 5) -> list[str]:
+    """The informative tail of a failed subprocess: prefer error-ish lines
+    (exception/UNAVAILABLE/traceback frames) over the platform warnings a
+    hung probe leaves as its only stderr — BENCH_r01-r05 showed every
+    failure as one clipped warning line, undebuggable from the JSON."""
+    lines = [l.rstrip() for l in ((err or "") + "\n" + (out or "")).splitlines()
+             if l.strip()]
+    errorish = [
+        l for l in lines
+        if any(t in l for t in (
+            "Error", "error:", "UNAVAILABLE", "Traceback", "raise ",
+            "Exception", "FAILED",
+        )) and not l.lstrip().startswith("WARNING")
+    ]
+    tail = (errorish or [l for l in lines
+                         if not l.lstrip().startswith("WARNING")] or lines)
+    return [l[:300] for l in tail[-limit:]]
+
+
+def _classify_failure(rc: int | None, out: str, err: str) -> dict:
+    """hang vs UNAVAILABLE vs crash, with the classified stderr tail."""
+    text = (err or "") + "\n" + (out or "")
+    if rc is None:
+        kind = "hang_timeout"
+    elif "UNAVAILABLE" in text:
+        kind = "unavailable"
+    else:
+        kind = "crash"
+    return {"kind": kind, "rc": rc, "tail": _failure_tail(out, err)}
+
+
+def _probe_device(timeout_s: float) -> tuple[bool, str, dict]:
+    """(ok, one-line summary, full classified detail)."""
+    t0 = time.monotonic()
     rc, out, err = _run_sub(
         [sys.executable, "-u", "-c", "import jax; print(jax.devices())"],
         timeout_s,
     )
+    elapsed = round(time.monotonic() - t0, 1)
     if rc == 0:
         last = out.strip().splitlines()[-1] if out.strip() else ""
         # rc=0 with a CPU-only device list means jax fell back to the CPU
         # backend (e.g. JAX_PLATFORMS cleared) — that is NOT a healthy TPU:
         # the worker would bank a CPU number under the TPU metric.
         if any(tag in last.lower() for tag in ("tpu", "axon")):
-            return True, last
-        return False, f"no TPU device (got {last[:120]!r})"
-    reason = "hang/timeout" if rc is None else f"rc={rc}"
-    tail = (err or out).strip().splitlines()[-1:] or [""]
-    return False, f"{reason}: {tail[0][:200]}"
+            return True, last, {"kind": "ok", "device": last[:200],
+                                "elapsed_s": elapsed}
+        return False, f"no TPU device (got {last[:120]!r})", {
+            "kind": "no_tpu_device", "rc": 0, "device": last[:200],
+            "elapsed_s": elapsed,
+        }
+    detail = _classify_failure(rc, out, err)
+    detail["elapsed_s"] = elapsed
+    last = detail["tail"][-1] if detail["tail"] else ""
+    return False, f"{detail['kind']}: {last[:200]}", detail
 
 
-def _probe_loop(deadline_s: float, probe_timeout_s: float, sleep_s: float) -> bool:
-    """Retry the device probe until it succeeds or the deadline passes.
-    The tunnel's wedge clears on its own — waiting is the fix."""
+def _probe_loop(
+    deadline_s: float, probe_timeout_s: float, sleep_s: float
+) -> tuple[bool, list[dict]]:
+    """Retry the device probe until it succeeds or the deadline passes;
+    returns (ok, per-attempt classified records). The tunnel's wedge
+    clears on its own — waiting is the fix — and each attempt's detail
+    (classification, elapsed, wait before the next try) lands in the
+    emitted JSON so the perf trajectory stays debuggable from
+    BENCH_*.json alone."""
     t_end = time.monotonic() + deadline_s
-    attempt = 0
+    attempts: list[dict] = []
     while True:
-        attempt += 1
-        ok, info = _probe_device(probe_timeout_s)
-        _log(f"probe #{attempt}: {'OK ' + info if ok else 'FAIL ' + info}")
+        ok, info, detail = _probe_device(probe_timeout_s)
+        rec = {"attempt": len(attempts) + 1, **detail}
+        attempts.append(rec)
+        _log(f"probe #{rec['attempt']}: {'OK ' + info if ok else 'FAIL ' + info}")
         if ok:
-            return True
+            return True, attempts
         remaining = t_end - time.monotonic()
         if remaining <= 0:
-            return False
-        time.sleep(min(sleep_s, max(remaining, 1.0)))
+            rec["wait_s"] = 0.0
+            return False, attempts
+        wait = min(sleep_s, max(remaining, 1.0))
+        rec["wait_s"] = round(wait, 1)
+        time.sleep(wait)
 
 
 def _parse_worker_json(out: str) -> dict | None:
@@ -276,9 +325,19 @@ def _driver() -> dict:
     attempts = int(os.environ.get("DDS_BENCH_ATTEMPTS", "2"))
 
     errors: list[str] = []
+    probes: list[dict] = []   # per-driver-attempt probe attempt records
+    workers: list[dict] = []  # per-driver-attempt worker failure records
     for attempt in range(1, attempts + 1):
-        if not _probe_loop(probe_deadline, probe_timeout, probe_sleep):
-            errors.append(f"attempt {attempt}: device probe never succeeded")
+        ok, probe_attempts = _probe_loop(
+            probe_deadline, probe_timeout, probe_sleep
+        )
+        probes.append({"driver_attempt": attempt, "attempts": probe_attempts})
+        if not ok:
+            kinds = [a["kind"] for a in probe_attempts]
+            errors.append(
+                f"attempt {attempt}: device probe never succeeded "
+                f"({len(probe_attempts)} probes: {', '.join(kinds)})"
+            )
             continue
         _log(f"worker attempt {attempt} (timeout {worker_timeout:.0f}s)")
         rc, out, err = _run_sub(
@@ -295,13 +354,28 @@ def _driver() -> dict:
                     "killed/timeout" if rc is None else f"rc={rc}"
                 )
             return row
-        reason = "hang/timeout" if rc is None else f"rc={rc}"
-        tail = (err or out).strip().splitlines()[-1:] or [""]
-        errors.append(f"attempt {attempt}: worker {reason}: {tail[0][:300]}")
+        wdetail = _classify_failure(rc, out, err)
+        workers.append({"driver_attempt": attempt, **wdetail})
+        last = wdetail["tail"][-1] if wdetail["tail"] else ""
+        errors.append(
+            f"attempt {attempt}: worker {wdetail['kind']}: {last[:300]}"
+        )
         _log(errors[-1])
 
-    # unrecoverable: emit the failure shape + CPU baseline, never a traceback
-    detail: dict = {"errors": errors}
+    # unrecoverable: emit the failure shape + CPU baseline, never a
+    # traceback — with the FULL classified probe/worker history so the
+    # perf trajectory is debuggable from the emitted JSON alone
+    detail: dict = {
+        "errors": errors,
+        "probe": {
+            "deadline_s": probe_deadline,
+            "timeout_s": probe_timeout,
+            "sleep_s": probe_sleep,
+            "driver_attempts": probes,
+        },
+    }
+    if workers:
+        detail["workers"] = workers
     try:
         detail.update(_cpu_fallback_detail())
     except Exception as e:  # noqa: BLE001 — the JSON line must still go out

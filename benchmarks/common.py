@@ -100,5 +100,15 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float, **detail) -> 
         kernel = None  # telemetry must never fail a benchmark
     if kernel is not None:
         row["kernel"] = kernel
+    try:
+        # perf-regression sentry feed: persist per-kernel p50/p95
+        # dispatch/execute stats into the baseline file (new kernels only
+        # unless DDS_KERNEL_BASELINE_UPDATE; DDS_KERNEL_BASELINE="" turns
+        # it off). benchmarks/sentry.py compares later runs against it.
+        from dds_tpu.obs import sentry as _sentry
+
+        _sentry.persist_from_tracer()
+    except Exception:
+        pass  # the baseline is telemetry too — never fail a benchmark
     print(json.dumps(row))
     return row

@@ -43,6 +43,18 @@ def main(argv=None):
     name = "results_quick.json" if args.quick else "results.json"
     out = pathlib.Path(__file__).with_name(name)
     out.write_text(json.dumps(rows, indent=2) + "\n")
+
+    # perf-regression sentry smoke: every suite run re-validates the
+    # stored kernel baseline file (emit() above will have grown it), so a
+    # corrupted baseline is caught here — including on CPU-only hosts —
+    # not at the next TPU gate. --check parses only; it never fails the
+    # suite on a perf delta.
+    from benchmarks import sentry
+
+    rc = sentry.main(["--check"])
+    if rc != 0:
+        print(json.dumps({"warning": "kernel baseline failed validation",
+                          "sentry_rc": rc}))
     return rows
 
 

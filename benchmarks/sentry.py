@@ -1,0 +1,143 @@
+"""Perf-regression sentry CLI: gate CI on per-kernel timing baselines.
+
+Modes (all emit one JSON line to stdout):
+
+    python benchmarks/sentry.py --check [--baseline PATH]
+        Parse + validate the stored baseline file only (no kernels run;
+        no jax import) — the CPU-only smoke CI runs so a corrupted
+        baseline is caught before it silently disables gating.
+        Exit 0 on a valid (or absent) baseline, 2 on a malformed one.
+
+    python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
+        Run the probe workload and (over)write its stats as the new
+        baseline. Exit 0.
+
+    python benchmarks/sentry.py [--baseline PATH] [--fresh STATS.json]
+                                [--threshold 0.2] [--repeats N]
+        Compare a fresh measurement — the probe workload, or a stats
+        JSON captured elsewhere (`--fresh`) — against the stored
+        baseline. Exit 1 when any kernel phase regressed by more than
+        `--threshold` (default 20%), 2 on a malformed baseline/stats
+        file, 0 when clean (including "nothing to compare": an empty
+        baseline can never fail the gate, it just reports coverage 0).
+
+The probe workload drives `ops.foldmany` (the aggregate-fold kernel
+behind `SumAll`) at two fixed shapes; it runs on whatever jax backend is
+available, so the same invocation gates CPU CI and TPU perf runs — each
+environment keeps its OWN baseline file (a CPU p50 is meaningless
+against a TPU one, which is why the kernel key includes shape but the
+FILE is per-environment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dds_tpu.obs import sentry  # noqa: E402 — stdlib-only import
+
+
+def probe(repeats: int = 5) -> dict:
+    """Deterministic probe workload: a handful of foldmany dispatches at
+    two shapes, collected from a fresh tracer ring."""
+    from dds_tpu.ops.foldmany import fold_many
+    from dds_tpu.utils.trace import tracer
+
+    # a fixed odd modulus (Mersenne 127) keeps ModCtx shapes stable; the
+    # UNMEASURED warmup pass eats the trace+compile cost so the recorded
+    # dispatch stats are steady-state — a cold compile is ~4x a warm
+    # dispatch and would gate on cache temperature, not kernel speed
+    n = (1 << 127) - 1
+    folds_small = [[3, 5, 7], [11, 13]]
+    folds_wide = [[3, 5, 7, 11, 13, 17, 19, 23]] * 4
+    fold_many(folds_small, n)
+    fold_many(folds_wide, n)
+    tracer.reset()
+    for _ in range(max(1, repeats)):
+        fold_many(folds_small, n)
+        fold_many(folds_wide, n)
+    return sentry.collect()
+
+
+def _load_fresh(path: str) -> dict:
+    """A stats JSON: either the baseline schema or a bare kernels dict."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("kernels"), dict):
+        return data["kernels"]
+    if isinstance(data, dict):
+        return data
+    raise ValueError(f"malformed fresh stats {path!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: DDS_KERNEL_BASELINE or "
+                         "benchmarks/kernel_baseline.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the baseline file and exit")
+    ap.add_argument("--record", action="store_true",
+                    help="run the probe and store its stats as the baseline")
+    ap.add_argument("--fresh", default=None,
+                    help="compare this stats JSON instead of running the probe")
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="regression gate as a fraction (default 0.20)")
+    ap.add_argument("--floor-ms", type=float, default=0.05,
+                    help="ignore deltas below this many ms (timer noise)")
+    ap.add_argument("--repeats", type=int, default=5,
+                    help="probe workload repetitions")
+    args = ap.parse_args(argv)
+
+    path = str(sentry.baseline_path(args.baseline))
+    try:
+        baseline = sentry.load_baseline(args.baseline)
+    except ValueError as e:
+        print(json.dumps({"ok": False, "baseline": path, "error": str(e)}))
+        return 2
+
+    if args.check:
+        print(json.dumps({
+            "ok": True, "mode": "check", "baseline": path,
+            "kernels": len(baseline), "exists": bool(baseline),
+        }))
+        return 0
+
+    if args.record:
+        stats = probe(args.repeats)
+        sentry.save_baseline(stats, args.baseline, overwrite=True)
+        print(json.dumps({
+            "ok": True, "mode": "record", "baseline": path,
+            "kernels": sorted(stats),
+        }))
+        return 0
+
+    try:
+        fresh = _load_fresh(args.fresh) if args.fresh else probe(args.repeats)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(json.dumps({"ok": False, "baseline": path, "error": str(e)}))
+        return 2
+
+    findings = sentry.compare(
+        baseline, fresh, threshold=args.threshold, floor_ms=args.floor_ms
+    )
+    compared = sorted(set(baseline) & set(fresh))
+    print(json.dumps({
+        "ok": not findings,
+        "mode": "compare",
+        "baseline": path,
+        "threshold": args.threshold,
+        "compared": compared,
+        "uncovered": sorted(set(fresh) - set(baseline)),
+        "regressions": findings,
+    }))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -263,12 +263,16 @@ class AntiEntropy:
                 meta["outcome"] = "bad_keys_reply"
                 return 0
 
-            stale: list[str] = []
+            # key -> the peer's ADVERTISED (seq, id): kept so each repaired
+            # entry can be audited against what the peer claimed to hold
+            # (Watchtower's repair_convergence invariant — a peer that
+            # advertises fresh but serves stale never converges)
+            stale: dict[str, tuple] = {}
             for key, ent in keys_reply.entries.items():
                 seq, tid, vd = int(ent[0]), str(ent[1]), str(ent[2])
                 local = node.merkle.get(key)
                 if local is None or (local[0].seq, local[0].id) < (seq, tid):
-                    stale.append(key)
+                    stale[key] = (seq, tid)
                 elif (local[0].seq, local[0].id) == (seq, tid) and local[1] != vd:
                     # same tag, different value: one side holds a forged or
                     # corrupted value under a real tag — evidence, not a
@@ -287,8 +291,9 @@ class AntiEntropy:
                         remote=[seq, tid, vd],
                     )
 
-            for i in range(0, len(stale), self.REPAIR_BATCH):
-                batch = stale[i:i + self.REPAIR_BATCH]
+            stale_keys = list(stale)
+            for i in range(0, len(stale_keys), self.REPAIR_BATCH):
+                batch = stale_keys[i:i + self.REPAIR_BATCH]
                 nonce = sigs.generate_nonce()
                 repair = await self._ask(peer, M.RepairRequest(batch, nonce))
                 if not isinstance(repair, M.RepairReply):
@@ -315,6 +320,15 @@ class AntiEntropy:
                     if cur is None or cur[0] < tag:
                         node._store(key, tag, value)
                         repaired += 1
+                        src = stale[key]
+                        # audit feed: installed vs advertised tag, checked
+                        # by Watchtower's repair_convergence invariant
+                        tracer.event(
+                            "audit.repair", replica=node.name,
+                            peer=peer.rsplit("/", 1)[-1], key=key,
+                            src_seq=src[0], src_id=src[1],
+                            seq=tag.seq, tag_id=tag.id,
+                        )
             if repaired:
                 metrics.inc(
                     "dds_antientropy_repaired_keys_total", repaired,

@@ -215,32 +215,44 @@ class AbdClient:
         caller's remaining budget."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce)
+        # validation runs INSIDE the span so a committed op's span carries
+        # its audit facts (ok/key/tag) — the Watchtower auditor
+        # (obs/watchtower) scopes each op's quorum participants to this
+        # span's subtree and checks per-key tag monotonicity from the
+        # annotated tag; a failed attempt records the span without `ok`
+        # and is never audited as a commit.
+        cfg = self.cfg
         with tracer.span("abd.fetch") as span_meta:
             reply, coord, challenge = await self._ask(
                 M.IRead(key), nonce, sig, exclude, deadline, op="fetch"
             )
             span_meta["coordinator"] = coord
 
-        cfg = self.cfg
-        match reply:
-            case M.Envelope(M.IReadReply(k, value, tag), rnonce, rsig):
-                if rnonce != challenge:
+            match reply:
+                case M.Envelope(M.IReadReply(k, value, tag), rnonce, rsig):
+                    if rnonce != challenge:
+                        self._coord_failed(coord)
+                        raise ByzFailedNonceChallengeError(coord)
+                    if not sigs.validate_proxy_signature(
+                        cfg.proxy_mac_secret, k, rnonce, rsig,
+                        [value, sigs.tag_payload(tag)],
+                    ):
+                        self._coord_failed(coord)
+                        raise ByzInvalidSignatureError(coord)
+                    if k != key:
+                        self._coord_failed(coord)
+                        raise ByzInvalidKeyError(coord)
+                    self._breaker(coord).record_success()
+                    span_meta["ok"] = True
+                    span_meta["op"] = "read"
+                    span_meta["key"] = key
+                    if tag is not None:
+                        span_meta["seq"] = tag.seq
+                        span_meta["tag_id"] = tag.id
+                    return value, tag, coord
+                case _:
                     self._coord_failed(coord)
-                    raise ByzFailedNonceChallengeError(coord)
-                if not sigs.validate_proxy_signature(
-                    cfg.proxy_mac_secret, k, rnonce, rsig,
-                    [value, sigs.tag_payload(tag)],
-                ):
-                    self._coord_failed(coord)
-                    raise ByzInvalidSignatureError(coord)
-                if k != key:
-                    self._coord_failed(coord)
-                    raise ByzInvalidKeyError(coord)
-                self._breaker(coord).record_success()
-                return value, tag, coord
-            case _:
-                self._coord_failed(coord)
-                raise ByzUnknownReplyError(coord)
+                    raise ByzUnknownReplyError(coord)
 
     async def write_set(self, key: str, value,
                         deadline: Optional[Deadline] = None) -> str:
@@ -252,31 +264,38 @@ class AbdClient:
         """Quorum write; returns (key, tag) where tag is the tag written."""
         nonce = sigs.generate_nonce()
         sig = sigs.proxy_signature(self.cfg.proxy_mac_secret, key, nonce, value)
+        cfg = self.cfg
         with tracer.span("abd.write") as span_meta:
             reply, coord, challenge = await self._ask(
                 M.IWrite(key, value), nonce, sig, (), deadline, op="write"
             )
             span_meta["coordinator"] = coord
 
-        cfg = self.cfg
-        match reply:
-            case M.Envelope(M.IWriteReply(k, tag), rnonce, rsig):
-                if rnonce != challenge:
+            match reply:
+                case M.Envelope(M.IWriteReply(k, tag), rnonce, rsig):
+                    if rnonce != challenge:
+                        self._coord_failed(coord)
+                        raise ByzFailedNonceChallengeError(coord)
+                    if not sigs.validate_proxy_signature(
+                        cfg.proxy_mac_secret, k, rnonce, rsig,
+                        sigs.tag_payload(tag),
+                    ):
+                        self._coord_failed(coord)
+                        raise ByzInvalidSignatureError(coord)
+                    if k != key:
+                        self._coord_failed(coord)
+                        raise ByzInvalidKeyError(coord)
+                    self._breaker(coord).record_success()
+                    span_meta["ok"] = True
+                    span_meta["op"] = "write"
+                    span_meta["key"] = key
+                    if tag is not None:
+                        span_meta["seq"] = tag.seq
+                        span_meta["tag_id"] = tag.id
+                    return k, tag
+                case _:
                     self._coord_failed(coord)
-                    raise ByzFailedNonceChallengeError(coord)
-                if not sigs.validate_proxy_signature(
-                    cfg.proxy_mac_secret, k, rnonce, rsig, sigs.tag_payload(tag)
-                ):
-                    self._coord_failed(coord)
-                    raise ByzInvalidSignatureError(coord)
-                if k != key:
-                    self._coord_failed(coord)
-                    raise ByzInvalidKeyError(coord)
-                self._breaker(coord).record_success()
-                return k, tag
-            case _:
-                self._coord_failed(coord)
-                raise ByzUnknownReplyError(coord)
+                    raise ByzUnknownReplyError(coord)
 
     def _on_tag_batch_reply(self, sender: str, msg: M.TagBatchReply) -> None:
         fut, votes, digest, keys, fp = self._pending_tags[msg.nonce]

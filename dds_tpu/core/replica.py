@@ -195,10 +195,17 @@ class BFTABDNode:
         # originating request's trace tree — the per-replica attribution a
         # process-global ring could never give. `replica` meta identifies
         # WHICH replica served each quorum leg.
-        with tracer.span(
-            "replica.handle", replica=self.name, msg=type(msg).__name__,
-            behavior=self.behavior,
-        ):
+        meta = {
+            "replica": self.name, "msg": type(msg).__name__,
+            "behavior": self.behavior,
+        }
+        # per-key attribution where the protocol message names one: lets
+        # the Watchtower auditor (and a human reading an incident) tie a
+        # phase participant to the record it touched
+        key = getattr(msg, "key", None)
+        if isinstance(key, str):
+            meta["key"] = key
+        with tracer.span("replica.handle", **meta):
             await self._dispatch(sender, msg)
 
     async def _dispatch(self, sender: str, msg) -> None:

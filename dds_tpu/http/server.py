@@ -42,6 +42,8 @@ from dds_tpu.models.backend import CryptoBackend, get_backend
 from dds_tpu.obs import context as obs_context
 from dds_tpu.obs.flight import flight
 from dds_tpu.obs.metrics import SIZE_BUCKETS, metrics
+from dds_tpu.obs.slo import SloEngine
+from dds_tpu.obs.watchtower import watchtower
 from dds_tpu.utils import sigs
 from dds_tpu.utils.retry import (
     Deadline,
@@ -153,6 +155,11 @@ class ProxyConfig:
     # per-span stats. Deployments that must hide even rates can turn it
     # off (config `obs.metrics_route = false`).
     metrics_route_enabled: bool = True
+    # GET /slo (per-route objectives + error-budget burn state, plus the
+    # Watchtower audit summary). Default ON for the same reason /metrics
+    # is: it is the health surface operators page on, and it reveals no
+    # more workload shape than the per-route metric series already do.
+    slo_route_enabled: bool = True
     # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
     replica_refresh_interval: float = 5.0
     supervisor: Optional[str] = None
@@ -171,9 +178,14 @@ async def _cancel_task(task: asyncio.Task) -> None:
 
 class DDSRestServer:
     def __init__(self, abd: AbdClient, config: ProxyConfig | None = None,
-                 local_replicas: dict | None = None):
+                 local_replicas: dict | None = None,
+                 slo: SloEngine | None = None):
         self.abd = abd
         self.cfg = config or ProxyConfig()
+        # per-route SLO accounting (obs/slo): every request is classified
+        # good/bad in handle(); run.launch passes an engine built from the
+        # [obs] config, tests get the defaults
+        self.slo = slo or SloEngine()
         # endpoint -> BFTABDNode for replicas hosted in THIS process (the
         # live dict from run.launch — redeploys mutate it in place), so
         # /health and /metrics can export the Aegis recovery surface:
@@ -696,8 +708,9 @@ class DDSRestServer:
             return Response(500)
         finally:
             _REQ_DEADLINE.reset(token)
+            dur = time.perf_counter() - t0
             metrics.observe(
-                "dds_http_request_seconds", time.perf_counter() - t0,
+                "dds_http_request_seconds", dur,
                 route=route or "root", method=req.method,
                 help="REST request latency by route",
             )
@@ -706,6 +719,7 @@ class DDSRestServer:
                 method=req.method, status=str(status),
                 help="REST requests by route and status",
             )
+            self.slo.observe(route or "root", status, dur)
 
     def _unavailable(self, why: str) -> Response:
         import math
@@ -930,6 +944,14 @@ class DDSRestServer:
                     content_type="text/plain; version=0.0.4; charset=utf-8",
                 )
 
+            case ("GET", "slo") if self.cfg.slo_route_enabled:
+                # per-route objective/burn state (obs/slo) plus the
+                # Watchtower audit summary — the automated-verdict
+                # surface: what is burning budget, what invariants broke
+                return Response.json(
+                    {"slo": self.slo.report(), "audit": watchtower.stats()}
+                )
+
             case ("GET", "_trace") if self.cfg.trace_route_enabled:
                 # live observability (SURVEY §5.5): per-span timing summary
                 # (count/total/mean/p50/p95 ms) from utils/trace, counters
@@ -969,6 +991,15 @@ class DDSRestServer:
         )
         metrics.set("dds_stored_keys", len(self.stored_keys),
                     help="aggregate key-set size")
+        # SLO burn/budget gauges + audit backlog (scrape-time freshness is
+        # all a gauge promises; the violation COUNTER increments at
+        # detection time in the auditor itself)
+        self.slo.export_gauges(metrics)
+        wt = watchtower.stats()
+        metrics.set("dds_audit_traces_audited", wt["traces_audited"],
+                    help="traces audited by the Watchtower since start")
+        metrics.set("dds_audit_pending_traces", wt["pending_traces"],
+                    help="in-flight traces buffered awaiting audit")
         # Aegis recovery surface (local replicas only): anti-entropy
         # divergence + sync age, snapshot generation + age
         for node in (self.local_replicas or {}).values():

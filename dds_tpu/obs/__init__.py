@@ -6,6 +6,9 @@
   `GET /metrics` (http/server.py).
 - `obs.flight` — fault-triggered incident dumps (JSONL post-mortems).
 - `obs.kprof` — kernel dispatch/compile-vs-execute profiling hooks.
+- `obs.watchtower` — online BFT invariant auditor over completed traces.
+- `obs.slo` — per-route latency objectives + error-budget burn tracking.
+- `obs.sentry` — per-kernel timing baselines + regression comparison.
 
 `flight` and `kprof` import `utils/trace`, which imports `obs.context` —
 so this package eagerly exposes only the leaf modules and lazily resolves
@@ -15,11 +18,14 @@ the rest (PEP 562) to keep the import graph acyclic.
 from dds_tpu.obs import context  # noqa: F401
 from dds_tpu.obs.metrics import Registry, metrics  # noqa: F401
 
-__all__ = ["context", "metrics", "Registry", "flight", "kprof"]
+__all__ = [
+    "context", "metrics", "Registry", "flight", "kprof",
+    "watchtower", "slo", "sentry",
+]
 
 
 def __getattr__(name):
-    if name in ("flight", "kprof"):
+    if name in ("flight", "kprof", "watchtower", "slo", "sentry"):
         import importlib
 
         return importlib.import_module(f"{__name__}.{name}")

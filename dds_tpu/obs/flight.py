@@ -16,6 +16,12 @@ rate-limited per kind (`min_interval`) and pruned to `max_incidents`
 files, so a flapping breaker cannot fill a disk. Writes are atomic
 (tmp + rename): a crash mid-dump leaves no truncated incident.
 
+Every incident also appends one line to `<dir>/index.jsonl` —
+`{"ts", "kind", "trace_id", "path"}` — so operators (and tooling)
+enumerate incidents in order without globbing or opening each file;
+pruning rewrites the index to drop entries whose file is gone, keeping
+it authoritative under the same `max_incidents` retention bound.
+
 Env flags: DDS_OBS_FLIGHT_DIR, DDS_OBS_FLIGHT_MAX (default 32),
 DDS_OBS_FLIGHT_INTERVAL (seconds per kind, default 1.0).
 """
@@ -136,18 +142,59 @@ class FlightRecorder:
                 ) + "\n")
         path = d / name
         os.replace(tmp, path)
+        self._index_append(d, {
+            "ts": header["ts"], "kind": kind, "trace_id": trace_id,
+            "path": name,
+        })
         metrics.inc("dds_incidents_total", kind=kind,
                     help="flight-recorder incident dumps written")
         self._prune(d)
         return str(path)
 
+    INDEX = "index.jsonl"
+
+    def _index_append(self, d: pathlib.Path, entry: dict) -> None:
+        try:
+            with open(d / self.INDEX, "a") as f:
+                f.write(json.dumps(entry, default=str) + "\n")
+        except OSError as e:
+            log.warning("flight index append failed: %s", e)
+
     def _prune(self, d: pathlib.Path) -> None:
         incidents = sorted(d.glob("incident-*.jsonl"))
-        for old in incidents[: max(0, len(incidents) - self.max_incidents)]:
+        pruned = incidents[: max(0, len(incidents) - self.max_incidents)]
+        for old in pruned:
             try:
                 old.unlink()
             except OSError:
                 pass
+        if pruned:
+            self._rewrite_index(d)
+
+    def _rewrite_index(self, d: pathlib.Path) -> None:
+        """Drop index entries whose incident file is gone (atomic rewrite:
+        a crash mid-prune leaves the previous index, never a truncated
+        one). Unparseable lines are dropped too — the index is derived
+        state, the incident files stay authoritative."""
+        idx = d / self.INDEX
+        try:
+            lines = idx.read_text().splitlines()
+        except OSError:
+            return
+        kept = []
+        for line in lines:
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(entry, dict) and (d / str(entry.get("path"))).exists():
+                kept.append(json.dumps(entry, default=str))
+        try:
+            tmp = idx.with_name(idx.name + ".tmp")
+            tmp.write_text("".join(l + "\n" for l in kept))
+            os.replace(tmp, idx)
+        except OSError as e:
+            log.warning("flight index rewrite failed: %s", e)
 
 
 # process-wide recorder; run.launch() configures it from DDSConfig.obs

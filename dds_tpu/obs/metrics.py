@@ -41,7 +41,16 @@ def _label_key(labels: dict) -> tuple:
 
 
 def _escape(v: str) -> str:
+    # label VALUE escaping per the text-format spec: backslash first (or
+    # the escapes we add would themselves be re-escaped), then quote and
+    # newline — a raw newline would split the sample line mid-series
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    # HELP text escaping per the spec: only backslash and newline (quotes
+    # are legal in help text, unlike in label values)
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt(value: float) -> str:
@@ -76,6 +85,11 @@ class Registry:
             raise ValueError(
                 f"metric {name!r} already registered as {fam.kind}, not {kind}"
             )
+        elif not fam.help and help:
+            # backfill: the first touch may come from a call site that
+            # passes no help (scrape-time gauges are set from several
+            # places) — a later documented touch must still yield # HELP
+            fam.help = help
         return fam
 
     def inc(self, name: str, n: float = 1.0, help: str = "", **labels) -> None:
@@ -141,7 +155,7 @@ class Registry:
             for name in sorted(self._families):
                 fam = self._families[name]
                 if fam.help:
-                    out.append(f"# HELP {name} {fam.help}")
+                    out.append(f"# HELP {name} {_escape_help(fam.help)}")
                 out.append(f"# TYPE {name} {fam.kind}")
                 for key in sorted(fam.samples):
                     labels = dict(key)

@@ -1,0 +1,206 @@
+"""Perf-regression sentry core: per-kernel timing baselines + comparison.
+
+"Cost-Effective Optimization of CRT-Paillier Decryption" and "HEAAN
+Demystified" both make the same methodological point: HE performance
+claims need CONTINUOUS per-phase measurement against a baseline, not a
+one-off benchmark. This module is the mechanism: it distills the kprof
+spans (`kernel.<name>.dispatch` / `kernel.<name>.execute`, see obs/kprof)
+into per-kernel-and-shape p50/p95 statistics, persists them as a baseline
+file, and compares a fresh run against the stored baseline so CI can gate
+on ">20% slower than last time" (`benchmarks/sentry.py` is the CLI).
+
+Baseline file schema (JSON):
+
+    {"version": 1, "updated": <unix ts>, "kernels": {
+        "<kernel>[k=...,R=...]": {
+            "dispatch": {"p50_ms": ..., "p95_ms": ..., "count": N},
+            "execute":  {"p50_ms": ..., "p95_ms": ..., "count": N}}}}
+
+Kernels are keyed by name plus the shape-ish span meta (`k`, `R`, `P2`)
+so a baseline taken at one fold width is never compared against another.
+`benchmarks/common.emit()` persists new kernels opportunistically on
+every benchmark run (existing entries are kept unless
+`DDS_KERNEL_BASELINE_UPDATE` is truthy), so the baseline grows with the
+benchmark suite instead of needing a separate recording ritual.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import pathlib
+import time
+
+__all__ = [
+    "collect", "load_baseline", "save_baseline", "compare",
+    "baseline_path", "persist_from_tracer",
+]
+
+PHASES = ("dispatch", "execute")
+# span meta keys that describe the kernel's shape (batch width, request
+# fan-in, padded sizes) — part of the baseline key, never averaged across
+SHAPE_KEYS = ("k", "K", "R", "P2", "L")
+
+_VERSION = 1
+_DEFAULT_BASENAME = "kernel_baseline.json"
+
+
+def baseline_path(path: str | None = None) -> pathlib.Path:
+    """Resolve the baseline file path: explicit arg > DDS_KERNEL_BASELINE
+    env > benchmarks/kernel_baseline.json next to this repo's benchmarks."""
+    if path:
+        return pathlib.Path(path)
+    env = os.environ.get("DDS_KERNEL_BASELINE", "")
+    if env:
+        return pathlib.Path(env)
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    return repo / "benchmarks" / _DEFAULT_BASENAME
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    k = len(sorted_vals)
+    return sorted_vals[max(0, min(k - 1, math.ceil(q * k) - 1))]
+
+
+def collect(trc=None) -> dict:
+    """Per-kernel {phase: {p50_ms, p95_ms, count}} from the tracer ring's
+    `kernel.*` spans, keyed by kernel name + shape meta."""
+    if trc is None:
+        from dds_tpu.utils.trace import tracer as trc  # late: avoid cycles
+    groups: dict[str, dict[str, list[float]]] = {}
+    for e in trc.events():
+        if e.kind != "span" or not e.name.startswith("kernel."):
+            continue
+        base, _, phase = e.name[len("kernel."):].rpartition(".")
+        if phase not in PHASES or not base:
+            continue
+        shape = ",".join(
+            f"{k}={e.meta[k]}" for k in SHAPE_KEYS if k in e.meta
+        )
+        key = f"{base}[{shape}]" if shape else base
+        groups.setdefault(key, {}).setdefault(phase, []).append(e.dur_ms)
+    out: dict = {}
+    for key, phases in sorted(groups.items()):
+        entry = {}
+        for phase, durs in phases.items():
+            durs.sort()
+            entry[phase] = {
+                "p50_ms": round(_percentile(durs, 0.50), 4),
+                "p95_ms": round(_percentile(durs, 0.95), 4),
+                "count": len(durs),
+            }
+        out[key] = entry
+    return out
+
+
+# ---------------------------------------------------------------- baseline
+
+
+def load_baseline(path: str | None = None) -> dict:
+    """Load and validate a baseline file; returns its `kernels` dict.
+    Raises ValueError on a malformed file (the sentry CLI maps this to a
+    non-zero exit so CI catches a corrupted baseline, not just a slow
+    kernel). A missing file returns {}."""
+    p = baseline_path(path)
+    if not p.exists():
+        return {}
+    try:
+        data = json.loads(p.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"unreadable baseline {p}: {e}") from e
+    if not isinstance(data, dict) or not isinstance(data.get("kernels"), dict):
+        raise ValueError(f"malformed baseline {p}: expected {{'kernels': ...}}")
+    kernels = {}
+    for name, entry in data["kernels"].items():
+        if not isinstance(entry, dict):
+            raise ValueError(f"malformed baseline entry {name!r} in {p}")
+        for phase, stats in entry.items():
+            if phase not in PHASES or not isinstance(stats, dict):
+                raise ValueError(
+                    f"malformed baseline phase {name!r}.{phase!r} in {p}"
+                )
+            for k in ("p50_ms", "p95_ms"):
+                if not isinstance(stats.get(k), (int, float)):
+                    raise ValueError(
+                        f"baseline {name!r}.{phase}.{k} is not a number in {p}"
+                    )
+        kernels[str(name)] = entry
+    return kernels
+
+
+def save_baseline(stats: dict, path: str | None = None,
+                  overwrite: bool = False) -> dict:
+    """Merge `stats` into the baseline file (atomic tmp+rename). Existing
+    kernels win unless `overwrite` — a baseline is a COMMITMENT, and a
+    routine benchmark run must not silently ratchet it to a slower value.
+    Returns the merged kernels dict."""
+    p = baseline_path(path)
+    try:
+        existing = load_baseline(p)
+    except ValueError:
+        existing = {}  # a corrupt baseline is replaced, not fatal
+    merged = dict(existing)
+    for name, entry in stats.items():
+        if overwrite or name not in merged:
+            merged[name] = entry
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_name(p.name + ".tmp")
+    tmp.write_text(json.dumps(
+        {"version": _VERSION, "updated": time.time(), "kernels": merged},
+        indent=1, sort_keys=True,
+    ))
+    os.replace(tmp, p)
+    return merged
+
+
+def persist_from_tracer(path: str | None = None,
+                        overwrite: bool | None = None) -> dict | None:
+    """Opportunistic baseline persistence for benchmarks/common.emit():
+    collect current kernel stats and merge them into the baseline file.
+    Returns the collected stats, or None when no kernel spans exist.
+    DDS_KERNEL_BASELINE="" disables; DDS_KERNEL_BASELINE_UPDATE=1 lets a
+    run overwrite existing entries (a deliberate re-baselining)."""
+    if "DDS_KERNEL_BASELINE" in os.environ and not os.environ["DDS_KERNEL_BASELINE"]:
+        return None
+    stats = collect()
+    if not stats:
+        return None
+    if overwrite is None:
+        overwrite = os.environ.get(
+            "DDS_KERNEL_BASELINE_UPDATE", ""
+        ).strip().lower() in ("1", "true", "yes", "on")
+    save_baseline(stats, path, overwrite=overwrite)
+    return stats
+
+
+# --------------------------------------------------------------- comparison
+
+
+def compare(baseline: dict, fresh: dict, threshold: float = 0.20,
+            floor_ms: float = 0.05) -> list[dict]:
+    """Regressions of `fresh` vs `baseline`: every (kernel, phase, stat)
+    where fresh > baseline * (1 + threshold) AND the absolute delta
+    clears `floor_ms` (sub-floor kernels are timer noise, not
+    regressions). Only kernels present in BOTH sides are compared — new
+    kernels have no baseline to regress from, vanished kernels are a
+    coverage change, not a slowdown. Returns a list of finding dicts,
+    empty = clean."""
+    findings = []
+    for name in sorted(set(baseline) & set(fresh)):
+        for phase in PHASES:
+            b, f = baseline[name].get(phase), fresh[name].get(phase)
+            if not b or not f:
+                continue
+            for stat in ("p50_ms", "p95_ms"):
+                bv, fv = float(b[stat]), float(f[stat])
+                if fv > bv * (1.0 + threshold) and fv - bv > floor_ms:
+                    findings.append({
+                        "kernel": name,
+                        "phase": phase,
+                        "stat": stat,
+                        "baseline_ms": bv,
+                        "fresh_ms": fv,
+                        "ratio": round(fv / bv, 3) if bv > 0 else None,
+                    })
+    return findings

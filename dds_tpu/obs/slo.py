@@ -1,0 +1,204 @@
+"""SLO engine: per-route latency objectives + error-budget burn tracking.
+
+A latency histogram tells you what happened; an SLO says whether it was
+GOOD ENOUGH. Each route carries an objective — "`objective` of requests
+answer under `latency_ms` without a server error" — and every request is
+classified good/bad at the REST edge (`http/server.handle`). Bad requests
+burn the route's error budget (`1 - objective`); the burn RATE over two
+rolling windows (a fast one that catches a cliff, a slow one that
+confirms it is not a blip) is the page signal, the multiwindow multi-
+burn-rate shape SRE alerting converged on. `GET /slo` serves the whole
+report; `export_gauges` mirrors it as `dds_slo_*` gauges for scrapers.
+
+Classification: good = HTTP status < 500 AND latency <= the route's
+threshold. 4xx are the client's fault and do not burn the server's
+budget; 503 degradations and deadline exhaustions do — that is exactly
+what the budget is for.
+
+Time is bucketed into fixed bins (fast_window/60, floor 1 s) so a window
+sum is O(bins), state stays bounded per route, and no per-request
+timestamps are retained.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from dataclasses import dataclass
+
+__all__ = ["RouteSlo", "SloEngine"]
+
+
+@dataclass(frozen=True)
+class RouteSlo:
+    """One route's objective: `objective` of requests good, where good
+    means `status < 500 and latency_ms <= latency`."""
+
+    objective: float = 0.99
+    latency_ms: float = 250.0
+
+
+class SloEngine:
+    def __init__(
+        self,
+        default: RouteSlo | None = None,
+        routes: dict[str, RouteSlo] | None = None,
+        windows: tuple[float, float] = (300.0, 3600.0),
+        burn_alert: float = 14.4,
+        clock=time.monotonic,
+    ):
+        self.default = default or RouteSlo()
+        self.routes = dict(routes or {})
+        fast, slow = float(windows[0]), float(windows[1])
+        if fast > slow:
+            fast, slow = slow, fast
+        self.windows = (fast, slow)
+        self.burn_alert = float(burn_alert)
+        self._clock = clock
+        self.bin_s = max(1.0, fast / 60.0)
+        maxbins = int(math.ceil(slow / self.bin_s)) + 1
+        # route -> deque of [bin_index, good, bad_latency, bad_error]
+        self._bins: dict[str, collections.deque] = collections.defaultdict(
+            lambda: collections.deque(maxlen=maxbins)
+        )
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_obs(cls, obs) -> "SloEngine":
+        """Build from an ObsConfig-shaped object (duck-typed so this module
+        never imports the config tree). Per-route overrides accept either
+        `latency-ms` (TOML idiom) or `latency_ms` keys."""
+        default = RouteSlo(
+            objective=float(getattr(obs, "slo_objective", 0.99)),
+            latency_ms=float(getattr(obs, "slo_latency_ms", 250.0)),
+        )
+        routes = {}
+        for name, spec in (getattr(obs, "slo_routes", None) or {}).items():
+            if not isinstance(spec, dict):
+                continue
+            routes[str(name)] = RouteSlo(
+                objective=float(spec.get("objective", default.objective)),
+                latency_ms=float(
+                    spec.get("latency-ms", spec.get("latency_ms",
+                                                    default.latency_ms))
+                ),
+            )
+        return cls(
+            default=default,
+            routes=routes,
+            windows=(
+                float(getattr(obs, "slo_fast_window", 300.0)),
+                float(getattr(obs, "slo_slow_window", 3600.0)),
+            ),
+            burn_alert=float(getattr(obs, "slo_burn_alert", 14.4)),
+        )
+
+    def slo_for(self, route: str) -> RouteSlo:
+        return self.routes.get(route, self.default)
+
+    # --------------------------------------------------------------- intake
+
+    def observe(self, route: str, status: int, dur_s: float) -> None:
+        slo = self.slo_for(route)
+        err = status >= 500
+        slow = dur_s * 1e3 > slo.latency_ms
+        idx = int(self._clock() / self.bin_s)
+        with self._lock:
+            bins = self._bins[route]
+            if not bins or bins[-1][0] != idx:
+                bins.append([idx, 0, 0, 0])
+            cur = bins[-1]
+            if err:
+                cur[3] += 1
+            elif slow:
+                cur[2] += 1
+            else:
+                cur[1] += 1
+
+    # -------------------------------------------------------------- reports
+
+    def _window_counts(self, bins, window: float) -> tuple[int, int, int]:
+        """(good, bad_latency, bad_error) over the trailing `window` s."""
+        cutoff = int((self._clock() - window) / self.bin_s)
+        good = bad_lat = bad_err = 0
+        for idx, g, bl, be in bins:
+            if idx > cutoff:
+                good += g
+                bad_lat += bl
+                bad_err += be
+        return good, bad_lat, bad_err
+
+    def report(self) -> dict:
+        """The `GET /slo` body: per observed route, the objective and the
+        per-window burn state. Burn rate = bad_fraction / error_budget
+        (1.0 = burning exactly at the sustainable rate; `burn_alert`x =
+        page). `budget_remaining` is the slow window's unspent fraction."""
+        out: dict = {
+            "windows_s": list(self.windows),
+            "burn_alert": self.burn_alert,
+            "routes": {},
+        }
+        with self._lock:
+            items = [(r, list(b)) for r, b in self._bins.items()]
+        for route, bins in sorted(items):
+            slo = self.slo_for(route)
+            budget = max(1e-9, 1.0 - slo.objective)
+            wreport = {}
+            burns = []
+            for w in self.windows:
+                good, bad_lat, bad_err = self._window_counts(bins, w)
+                total = good + bad_lat + bad_err
+                bad = bad_lat + bad_err
+                frac = (bad / total) if total else 0.0
+                burn = frac / budget
+                burns.append((burn, total, bad))
+                wreport[f"{int(w)}s"] = {
+                    "total": total,
+                    "bad": bad,
+                    "bad_latency": bad_lat,
+                    "bad_error": bad_err,
+                    "bad_fraction": round(frac, 6),
+                    "burn_rate": round(burn, 3),
+                }
+            _, slow_total, slow_bad = burns[-1]
+            remaining = (
+                1.0 - min(1.0, slow_bad / (slow_total * budget))
+                if slow_total else 1.0
+            )
+            out["routes"][route] = {
+                "objective": slo.objective,
+                "latency_ms": slo.latency_ms,
+                "windows": wreport,
+                "budget_remaining": round(remaining, 6),
+                # page only when BOTH windows burn hot: the fast window
+                # catches the cliff, the slow one proves it is sustained
+                "alert": all(b[0] >= self.burn_alert for b in burns),
+            }
+        return out
+
+    def export_gauges(self, registry) -> None:
+        """Mirror the report as scrape-time gauges (http/server calls this
+        from `_sample_state_gauges`)."""
+        rep = self.report()
+        for route, r in rep["routes"].items():
+            for wname, w in r["windows"].items():
+                registry.set(
+                    "dds_slo_burn_rate", w["burn_rate"], route=route,
+                    window=wname,
+                    help="error-budget burn rate (1.0 = sustainable) per window",
+                )
+            registry.set(
+                "dds_slo_error_budget_remaining", r["budget_remaining"],
+                route=route,
+                help="unspent error-budget fraction over the slow window",
+            )
+            registry.set(
+                "dds_slo_objective", r["objective"], route=route,
+                help="configured good-request objective per route",
+            )
+            registry.set(
+                "dds_slo_alert", 1.0 if r["alert"] else 0.0, route=route,
+                help="1 when both burn windows exceed the alert threshold",
+            )

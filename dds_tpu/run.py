@@ -28,6 +28,7 @@ from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
 from dds_tpu.core.supervisor import BFTSupervisor, SupervisorConfig
 from dds_tpu.core.transport import InMemoryNet, TcpNet
 from dds_tpu.http.server import DDSRestServer, ProxyConfig
+from dds_tpu.obs.slo import SloEngine
 from dds_tpu.malicious.trudy import Trudy
 from dds_tpu.models.facade import HomoProvider
 from dds_tpu.utils.config import DDSConfig
@@ -54,6 +55,13 @@ class Deployment:
         await self.server.stop()
         for s in self._stoppables:
             await s.stop()
+        # the Watchtower was configured for THIS deployment's quorum
+        # geometry; left attached it would audit a later deployment (or a
+        # test harness's cluster) against the wrong q/n and cry wolf
+        from dds_tpu.obs.watchtower import watchtower
+
+        if self.cfg.obs.audit_enabled:
+            watchtower.detach()
 
 
 async def launch(cfg: DDSConfig | None = None) -> Deployment:
@@ -359,10 +367,12 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             supervisor=sup_addr,
             trace_route_enabled=cfg.debug or cfg.obs.trace_route,
             metrics_route_enabled=cfg.obs.metrics_route,
+            slo_route_enabled=cfg.obs.slo_route,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),
         local_replicas=replicas,
+        slo=SloEngine.from_obs(cfg.obs),
     )
     await server.start()
 
@@ -415,6 +425,26 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
                     pass
 
         stoppables.append(_TaskStopper())
+
+    # Watchtower: the online invariant auditor rides the process tracer.
+    # Attached LAST — once nothing else in this launch can fail — so an
+    # aborted boot never leaves a mis-configured global auditor behind
+    # (Deployment.stop detaches it again). Quorum-intersection checks are
+    # only sound when every replica's handler spans land in THIS process's
+    # ring; a multi-host topology (names mapped to other hosts) keeps the
+    # tag/repair/state-machine checks and drops the quorum ones.
+    if cfg.obs.audit_enabled:
+        from dds_tpu.obs.watchtower import watchtower
+        from dds_tpu.utils.trace import tracer as _tracer
+
+        n_active = len(cfg.replicas.endpoints) - len(cfg.replicas.sentinent)
+        all_local = not cfg.replicas.addresses and not cfg.replicas.local
+        watchtower.configure(
+            quorum_size=cfg.replicas.byz_quorum_size,
+            n_replicas=n_active,
+            check_quorum=cfg.obs.audit_quorum_checks and all_local,
+        )
+        watchtower.attach(_tracer)
     return dep
 
 

@@ -215,6 +215,30 @@ class ObsConfig:
     # min seconds between incidents of the same kind (a flapping breaker
     # must not fill a disk)
     flight_min_interval: float = 1.0
+    # Watchtower online BFT invariant auditor (obs/watchtower): subscribes
+    # to completed traces and checks quorum intersection, per-key tag
+    # monotonicity, read-sees-latest, anti-entropy repair convergence, and
+    # breaker/suspicion state-machine legality; violations become
+    # dds_audit_violations_total + flight incidents, never exceptions.
+    audit_enabled: bool = True
+    # quorum-intersection checks need every replica's handler spans in
+    # THIS process's tracer ring; launch() additionally disables them when
+    # the topology splits replicas across hosts
+    audit_quorum_checks: bool = True
+    # SLO engine (obs/slo): per-route latency objectives + error-budget
+    # burn-rate windows, served at GET /slo and as dds_slo_* gauges.
+    # Default: objective of requests per route answer < latency-ms without
+    # a 5xx; per-route overrides under [obs.slo-routes.<Route>].
+    slo_route: bool = True
+    slo_objective: float = 0.99
+    slo_latency_ms: float = 250.0
+    slo_fast_window: float = 300.0
+    slo_slow_window: float = 3600.0
+    # page signal: both windows burning error budget at >= this multiple
+    # of the sustainable rate (14.4x = a 30-day budget gone in ~2 days)
+    slo_burn_alert: float = 14.4
+    # route name -> {"objective": float, "latency-ms": float}
+    slo_routes: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -27,6 +27,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import logging
 import math
 import os
 import threading
@@ -65,11 +66,48 @@ class Tracer:
     _events: collections.deque = field(init=False, repr=False)
     _counters: collections.Counter = field(init=False, repr=False)
     _lock: threading.Lock = field(init=False, repr=False)
+    _subscribers: list = field(init=False, repr=False)
+    _notifying: threading.local = field(init=False, repr=False)
 
     def __post_init__(self):
         self._events = collections.deque(maxlen=self.max_events)
         self._counters = collections.Counter()
         self._lock = threading.Lock()
+        self._subscribers = []
+        self._notifying = threading.local()
+
+    # ---------------------------------------------------------- subscribers
+
+    def subscribe(self, fn) -> None:
+        """Register `fn(SpanRecord)` to be called (outside the ring lock)
+        for every record. The consumer side of Watchtower: an online
+        auditor sees each span/event as it lands instead of polling the
+        ring. Subscribers must be cheap and must not raise — exceptions
+        are swallowed so telemetry consumers can never break the paths
+        being observed."""
+        if fn not in self._subscribers:
+            self._subscribers.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        if fn in self._subscribers:
+            self._subscribers.remove(fn)
+
+    def _notify(self, rec: "SpanRecord") -> None:
+        # re-entrancy guard: a subscriber that records a span of its own
+        # must not recurse into the subscriber chain again on this thread
+        if getattr(self._notifying, "active", False):
+            return
+        self._notifying.active = True
+        try:
+            for fn in list(self._subscribers):
+                try:
+                    fn(rec)
+                except Exception:  # noqa: BLE001 — observers never break observed paths
+                    logging.getLogger("dds.trace").exception(
+                        "trace subscriber failed"
+                    )
+        finally:
+            self._notifying.active = False
 
     @contextlib.contextmanager
     def span(self, name: str, /, _ctx: Optional[obs_context.SpanContext] = None,
@@ -101,10 +139,11 @@ class Tracer:
             (ctx.trace_id, ctx.span_id, ctx.parent_id) if ctx is not None
             else (None, None, None)
         )
+        rec = SpanRecord(time.time(), name, dur_ms, meta, tid, sid, pid, _kind)
         with self._lock:
-            self._events.append(
-                SpanRecord(time.time(), name, dur_ms, meta, tid, sid, pid, _kind)
-            )
+            self._events.append(rec)
+        if self._subscribers:
+            self._notify(rec)
 
     def event(self, name: str, /, **meta) -> None:
         """Zero-duration annotation attached to the ACTIVE trace (chaos

@@ -21,7 +21,10 @@ def test_tracer_spans_and_summary():
     s = t.summary()
     assert s["abd.fetch"]["count"] == 3
     assert s["abd.fetch"]["p95_ms"] >= 0
-    assert s["abd.suspect"]["count"] == 2
+    # counters are occurrences, not durations: reported via counters(),
+    # never mixed into the span summary (PR 2 split the two)
+    assert "abd.suspect" not in s
+    assert t.counters()["abd.suspect"] == 2
     assert len(t.events("abd.fetch")) == 3
 
 
@@ -40,7 +43,9 @@ def test_tracer_dump_jsonl(tmp_path):
     p = tmp_path / "trace.jsonl"
     assert t.dump_jsonl(str(p)) == 1
     rec = json.loads(p.read_text().strip())
-    assert rec["name"] == "a" and rec["foo"] == 1
+    # meta lives under its own key so span meta can never shadow the
+    # record's fields (PR 2 namespaced it)
+    assert rec["name"] == "a" and rec["meta"]["foo"] == 1
 
 
 def test_tracer_bounded():
