@@ -19,7 +19,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from benchmarks import (audit_cost, bft_sum, crossover, encrypt_modexp,
-                            mixed, product, put_concurrency, sweep)
+                            mixed, product, put_concurrency, shard_scaling,
+                            sweep)
 
     rows = []
     if args.quick:
@@ -29,6 +30,7 @@ def main(argv=None):
         rows += mixed.main(["--ops", "60"])
         rows += put_concurrency.main(["--ops", "32", "--clients", "1", "4"])
         rows += audit_cost.main(["--k", "256", "--requests", "5"])
+        rows += shard_scaling.main(["--ops", "120", "--shards", "1,2"])
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -38,6 +40,7 @@ def main(argv=None):
         rows += audit_cost.main([])
         rows += crossover.main([])
         rows += encrypt_modexp.main([])
+        rows += shard_scaling.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

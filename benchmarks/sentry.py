@@ -6,7 +6,10 @@ Modes (all emit one JSON line to stdout):
         Parse + validate the stored baseline file only (no kernels run;
         no jax import) — the CPU-only smoke CI runs so a corrupted
         baseline is caught before it silently disables gating.
-        Exit 0 on a valid (or absent) baseline, 2 on a malformed one.
+        Also parses any `shard scaling` records in benchmarks/results.json
+        / results_quick.json (benchmarks/shard_scaling.py output) so a
+        malformed scaling record is caught by the same smoke.
+        Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
         Run the probe workload and (over)write its stats as the new
@@ -64,6 +67,45 @@ def probe(repeats: int = 5) -> dict:
     return sentry.collect()
 
 
+def _check_shard_records() -> dict:
+    """Validate `shard scaling` rows (benchmarks/shard_scaling.py) in the
+    suite result files: each must carry a positive ops/s value and a
+    detail block naming its shard count and per-shard key split. Returns
+    {"rows": n} or raises ValueError on a malformed record — the same
+    contract load_baseline has, mapped to exit 2 by --check."""
+    found = 0
+    for name in ("results.json", "results_quick.json"):
+        path = os.path.join(REPO, "benchmarks", name)
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            try:
+                rows = json.load(f)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"unreadable results file {name}: {e}") from e
+        if not isinstance(rows, list):
+            raise ValueError(f"malformed results file {name}: expected a list")
+        for row in rows:
+            if not (isinstance(row, dict)
+                    and str(row.get("metric", "")).startswith("shard scaling")):
+                continue
+            detail = row.get("detail")
+            ok = (
+                isinstance(row.get("value"), (int, float)) and row["value"] > 0
+                and isinstance(detail, dict)
+                and isinstance(detail.get("shards"), int)
+                and detail["shards"] >= 1
+                and isinstance(detail.get("per_shard_keys"), dict)
+            )
+            if not ok:
+                raise ValueError(
+                    f"malformed shard-scaling record in {name}: "
+                    f"{row.get('metric')!r}"
+                )
+            found += 1
+    return {"rows": found}
+
+
 def _load_fresh(path: str) -> dict:
     """A stats JSON: either the baseline schema or a bare kernels dict."""
     with open(path) as f:
@@ -102,9 +144,16 @@ def main(argv=None) -> int:
         return 2
 
     if args.check:
+        try:
+            shard = _check_shard_records()
+        except ValueError as e:
+            print(json.dumps({"ok": False, "baseline": path,
+                              "error": str(e)}))
+            return 2
         print(json.dumps({
             "ok": True, "mode": "check", "baseline": path,
             "kernels": len(baseline), "exists": bool(baseline),
+            "shard_scaling_rows": shard["rows"],
         }))
         return 0
 

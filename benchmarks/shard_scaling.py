@@ -1,0 +1,129 @@
+"""Constellation shard-scaling sweep: fixed op budget, S in {1, 2, 4}.
+
+The structural claim behind sharding (ISSUE 5 / BTS-style lane
+partitioning): with a FIXED total replica fleet, aggregate point-op
+throughput is capped by one quorum's fan-out — every write costs two
+broadcast phases over all n replicas plus quorum replies, so partitioning
+the fleet into S independent groups of n/S (each with its own BFT quorum
+q = ceil((n + f + 1) / 2), f = floor((n/S - 1) / 3)) divides the per-op
+message fan-out by ~S and multiplies throughput accordingly, even on the
+single-process test fabric where the event loop is the bottleneck.
+
+The sweep drives a fixed TOTAL budget of put+get ops through the
+ShardRouter with `--workers` concurrent clients over the in-memory
+fabric (protocol cost only — no HTTP, no crypto: the HE layer is
+orthogonal to the sharding claim) and emits one `shard scaling` record
+per S via benchmarks/common.emit(), with per-shard op counts in the
+detail so imbalance is visible. vs_baseline = throughput relative to
+S=1. benchmarks/sentry.py --check parses these records from
+results.json as part of its CI smoke.
+
+Usage: python -m benchmarks.shard_scaling [--ops 400] [--shards 1,2,4]
+       [--fleet 16] [--workers 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+import time
+
+from benchmarks.common import emit
+
+
+def _quorum(n: int) -> tuple[int, int]:
+    """Canonical BFT geometry for an n-replica group: f = floor((n-1)/3),
+    q = ceil((n + f + 1) / 2)."""
+    f = (n - 1) // 3
+    return -(-(n + f + 1) // 2), f
+
+
+async def _drive(shards: int, fleet: int, ops: int, workers: int,
+                 seed: int) -> dict:
+    from dds_tpu.core.transport import InMemoryNet
+    from dds_tpu.shard import build_constellation
+
+    per_group = fleet // shards
+    q, f = _quorum(per_group)
+    net = InMemoryNet()
+    const = build_constellation(
+        net, shard_count=shards, n_active=per_group, n_sentinent=0,
+        quorum=q, max_faults=f, seed=seed,
+    )
+    router = const.router
+    rng = random.Random(seed)
+    keys = [f"BENCH-{i:05d}" for i in range(ops // 2)]
+    counter = {"i": 0}
+
+    async def worker():
+        while True:
+            i = counter["i"]
+            if i >= len(keys) * 2:
+                return
+            counter["i"] = i + 1
+            key = keys[i % len(keys)]
+            if i < len(keys):
+                await router.write_set(key, [key, i])
+            else:
+                await router.fetch_set(key)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker() for _ in range(workers)))
+    wall = time.perf_counter() - t0
+    per_shard = {g: len(ks) for g, ks in router.partition_keys(keys).items()}
+    await const.stop()
+    return {
+        "shards": shards,
+        "replicas_per_group": per_group,
+        "quorum": q,
+        "ops": len(keys) * 2,
+        "wall_s": round(wall, 4),
+        "ops_per_s": (len(keys) * 2) / wall,
+        "per_shard_keys": per_shard,
+        "rng": rng.random(),  # keep the seeded rng in the record's lineage
+    }
+
+
+def main(argv=None) -> list:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ops", type=int, default=400,
+                    help="total op budget per sweep point (puts + gets)")
+    ap.add_argument("--shards", default="1,2,4",
+                    help="comma-separated shard counts")
+    ap.add_argument("--fleet", type=int, default=16,
+                    help="TOTAL replicas, partitioned across the groups")
+    ap.add_argument("--workers", type=int, default=8,
+                    help="concurrent client workers")
+    ap.add_argument("--seed", type=int, default=13)
+    args = ap.parse_args(argv)
+
+    sweep = [int(s) for s in args.shards.split(",")]
+    for s in sweep:
+        if args.fleet % s or args.fleet // s < 4:
+            raise SystemExit(
+                f"--fleet {args.fleet} must divide by S={s} into groups "
+                f"of >= 4 replicas"
+            )
+
+    rows = []
+    base = None
+    for s in sweep:
+        res = asyncio.run(
+            _drive(s, args.fleet, args.ops, args.workers, args.seed)
+        )
+        res.pop("rng")
+        if base is None:
+            base = res["ops_per_s"]
+        rows.append(emit(
+            f"shard scaling: put+get ops/s @ S={s} "
+            f"({res['replicas_per_group']}x{s} replicas, q={res['quorum']})",
+            res["ops_per_s"], "ops/s",
+            vs_baseline=res["ops_per_s"] / base,
+            **res,
+        ))
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -19,3 +19,21 @@ class ByzInvalidKeyError(ByzantineError):
 
 class ByzUnknownReplyError(ByzantineError):
     """Reply type made no sense for the outstanding request."""
+
+
+class WrongShardError(Exception):
+    """The addressed replica group does not own the key under its current
+    shard map (Constellation epoch fencing, dds_tpu/shard). NOT a
+    ByzantineError: the replica behaved correctly — the caller's shard map
+    is stale (or a reshard is mid-flight). The proxy refreshes its map and
+    retries under the existing Deadline budget; no suspicion accrues."""
+
+    def __init__(self, key: str, replica_epoch: int | None = None,
+                 sent_epoch: int | None = None):
+        self.key = key
+        self.replica_epoch = replica_epoch
+        self.sent_epoch = sent_epoch
+        super().__init__(
+            f"key {key[:16]}... not owned by addressed group "
+            f"(replica epoch {replica_epoch}, request epoch {sent_epoch})"
+        )

@@ -69,6 +69,11 @@ class Envelope:
     call: Any          # one of the I* messages above
     nonce: int
     signature: bytes
+    # Constellation shard-map epoch the SENDER routed under (-1 =
+    # unsharded). Fenced at the replica: a group that does not own the
+    # key under ITS current map answers WrongShard instead of serving, so
+    # a stale map can never silently misroute an op during a reshard.
+    epoch: int = -1
 
 
 # --------------------------------------------------------------------------
@@ -131,6 +136,8 @@ class ReadTagBatch:
     # MACing all K tags — the steady-state fast path that keeps aggregate
     # freshness validation O(1) per side when nothing was written.
     fingerprint: Optional[bytes] = None
+    # shard-map epoch, same fencing contract as Envelope.epoch
+    epoch: int = -1
 
 
 @dataclass(frozen=True)
@@ -279,6 +286,11 @@ class StateChunk:
     session: int
     seq: int
     entries: dict
+    # which ingest path owns the session: "recovery" (SleepBegin reseed,
+    # replaces the repository) or "migrate" (ShardMigrateBegin, merges
+    # verified entries store-if-newer). Typed so a chunk that races its
+    # header can never complete the WRONG kind of session.
+    kind: str = "recovery"
 
 
 @dataclass(frozen=True)
@@ -345,6 +357,54 @@ class RepairReply:
 
 
 # --------------------------------------------------------------------------
+# Constellation sharding plane (dds_tpu/shard)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class WrongShard:
+    """Replica -> proxy: epoch fence rejection. The addressed group does
+    not own `key` under the replica's current shard map (epoch `epoch`).
+    `nonce` correlates: the challenge nonce for an Envelope op, the
+    request nonce for a ReadTagBatch. Signed with the proxy MAC over
+    (key, nonce, ["wrong-shard", epoch]) so an in-path attacker cannot
+    forge fence storms that stall the router with fake refreshes."""
+
+    key: str
+    epoch: int
+    nonce: int
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class ShardMigrateBegin:
+    """Rebalancer -> new-group replica: verified shard-migration header.
+    Same attestation frame as SleepBegin — `digests` is a quorum of
+    HMAC-signed state manifests from the SOURCE group, `support` the
+    distinct-signer threshold (>= f+1) — but the receiver MERGES attested
+    entries store-if-newer instead of replacing its repository, stays in
+    its current behavior, and only accepts entries its own shard map says
+    it owns at `epoch`. `total` StateChunk(kind="migrate") frames follow."""
+
+    digests: list
+    session: int
+    total: int
+    support: int
+    epoch: int
+
+
+@dataclass(frozen=True)
+class ShardMigrateAck:
+    """New-group replica -> rebalancer: migration session result.
+    `accepted` counts entries installed (or already held at >= the
+    attested tag); `rejected` counts entries that failed the digest
+    quorum or fell outside the replica's owned keyspace."""
+
+    session: int
+    accepted: int
+    rejected: int
+
+
+# --------------------------------------------------------------------------
 # fault injection backdoor (malicious/MaliciousAttack.scala:34)
 # --------------------------------------------------------------------------
 
@@ -378,6 +438,7 @@ _TYPES = {
         StateDigestRequest, StateDigest, SleepBegin, StateChunk,
         MerkleRootRequest, MerkleRoot, MerkleBucketRequest, MerkleBuckets,
         MerkleKeysRequest, MerkleKeys, RepairRequest, RepairReply,
+        WrongShard, ShardMigrateBegin, ShardMigrateAck,
     )
 }
 

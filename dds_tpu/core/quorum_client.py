@@ -33,6 +33,7 @@ from dds_tpu.core.errors import (
     ByzInvalidKeyError,
     ByzInvalidSignatureError,
     ByzUnknownReplyError,
+    WrongShardError,
 )
 from dds_tpu.core.transport import Transport
 from dds_tpu.obs.metrics import metrics
@@ -67,6 +68,9 @@ class AbdClientConfig:
     # a healed partition serve again without a proxy restart.
     breaker_threshold: int = 3
     breaker_reset: float = 2.0
+    # Constellation shard label for this client's metric series (empty =
+    # unsharded, series keep their historical label sets)
+    shard: str = ""
 
 
 class AbdClient:
@@ -89,6 +93,11 @@ class AbdClient:
         # tag-broadcast nonce -> (future, sender->tags votes, digest, keys,
         # request fingerprint | None)
         self._pending_tags: dict[int, tuple] = {}
+        # Constellation: when a ShardRouter owns this client it installs a
+        # supplier for the ACTIVE map epoch; every Envelope/ReadTagBatch is
+        # stamped with it so replicas can fence stale routes. None = -1 =
+        # unsharded (replicas without a shard state ignore the field).
+        self.shard_epoch: Optional[callable] = None
         net.register(addr, self.handle)
 
     async def handle(self, sender: str, msg) -> None:
@@ -99,6 +108,20 @@ class AbdClient:
             return
         if isinstance(msg, M.TagBatchReply) and msg.nonce in self._pending_tags:
             self._on_tag_batch_reply(sender, msg)
+            return
+        if isinstance(msg, M.WrongShard):
+            # shard fence rejection: resolve the matching outstanding
+            # request (Envelope ops correlate by challenge nonce, tag
+            # batches by request nonce). Handled BEFORE the junk-reply
+            # fallthrough — a fence from a replica that also coordinates
+            # another in-flight op must not resolve THAT op as junk and
+            # earn the honest replica a suspicion strike.
+            if msg.nonce in self._pending:
+                fut, _ = self._pending[msg.nonce]
+                if not fut.done():
+                    fut.set_result(msg)
+            elif msg.nonce in self._pending_tags:
+                self._on_wrong_shard_batch(sender, msg)
             return
         if isinstance(msg, M.ActiveReplicas):
             if self.cfg.supervisor is not None and sender != self.cfg.supervisor:
@@ -145,6 +168,37 @@ class AbdClient:
         tracer.event("abd.coordinator_violation", node=coord)
         self._breaker(coord).record_failure()
 
+    def _mlabels(self, **labels) -> dict:
+        """Metric labels, plus the shard label when this client serves one
+        group of a constellation (unsharded series stay label-stable)."""
+        if self.cfg.shard:
+            labels["shard"] = self.cfg.shard
+        return labels
+
+    def _epoch(self) -> int:
+        return self.shard_epoch() if self.shard_epoch is not None else -1
+
+    def _check_wrong_shard(self, reply, coord: str, key: str, challenge: int):
+        """Validate a WrongShard fence reply for an Envelope op. A valid
+        fence raises WrongShardError (no suspicion — the replica behaved
+        correctly); a forged one is a protocol violation like any other."""
+        if not isinstance(reply, M.WrongShard):
+            return
+        cfg = self.cfg
+        if (
+            reply.nonce != challenge
+            or reply.key != key
+            or not sigs.validate_proxy_signature(
+                cfg.proxy_mac_secret, reply.key, reply.nonce, reply.signature,
+                ["wrong-shard", reply.epoch],
+            )
+        ):
+            self._coord_failed(coord)
+            raise ByzInvalidSignatureError(coord)
+        self._breaker(coord).record_success()
+        raise WrongShardError(key, replica_epoch=reply.epoch,
+                              sent_epoch=self._epoch())
+
     def _attempt_timeout(self, deadline: Optional[Deadline]) -> float:
         """Per-attempt timeout, clipped to the caller's remaining budget."""
         if deadline is None:
@@ -172,13 +226,17 @@ class AbdClient:
         self._pending[challenge] = (fut, coordinator)
         t0 = time.perf_counter()
         try:
-            self.net.send(self.addr, coordinator, M.Envelope(call, nonce, signature))
+            self.net.send(
+                self.addr, coordinator,
+                M.Envelope(call, nonce, signature, epoch=self._epoch()),
+            )
             try:
                 reply = await asyncio.wait_for(fut, timeout)
             except asyncio.TimeoutError:
                 metrics.inc(
-                    "dds_quorum_timeouts_total", op=op,
-                    node=coordinator.rsplit("/", 1)[-1],
+                    "dds_quorum_timeouts_total", **self._mlabels(
+                        op=op, node=coordinator.rsplit("/", 1)[-1],
+                    ),
                     help="quorum rounds that timed out per coordinator",
                 )
                 # transient unreachability: breaker only — the permanent
@@ -189,7 +247,8 @@ class AbdClient:
                 self._breaker(coordinator).record_failure()
                 raise
             metrics.observe(
-                "dds_quorum_rtt_seconds", time.perf_counter() - t0, op=op,
+                "dds_quorum_rtt_seconds", time.perf_counter() - t0,
+                **self._mlabels(op=op),
                 help="proxy->coordinator quorum round-trip time",
             )
             return reply, coordinator, challenge
@@ -227,6 +286,7 @@ class AbdClient:
                 M.IRead(key), nonce, sig, exclude, deadline, op="fetch"
             )
             span_meta["coordinator"] = coord
+            self._check_wrong_shard(reply, coord, key, challenge)
 
             match reply:
                 case M.Envelope(M.IReadReply(k, value, tag), rnonce, rsig):
@@ -270,6 +330,7 @@ class AbdClient:
                 M.IWrite(key, value), nonce, sig, (), deadline, op="write"
             )
             span_meta["coordinator"] = coord
+            self._check_wrong_shard(reply, coord, key, challenge)
 
             match reply:
                 case M.Envelope(M.IWriteReply(k, tag), rnonce, rsig):
@@ -296,6 +357,26 @@ class AbdClient:
                 case _:
                     self._coord_failed(coord)
                     raise ByzUnknownReplyError(coord)
+
+    def _on_wrong_shard_batch(self, sender: str, msg: M.WrongShard) -> None:
+        """A replica fenced a ReadTagBatch: the whole round fails with
+        WrongShardError (the router re-partitions against a fresh map). A
+        forged fence earns the sender a suspicion strike instead."""
+        fut, _, _, keys, _ = self._pending_tags[msg.nonce]
+        if fut.done():
+            return
+        if (
+            msg.key not in keys
+            or not sigs.validate_proxy_signature(
+                self.cfg.proxy_mac_secret, msg.key, msg.nonce, msg.signature,
+                ["wrong-shard", msg.epoch],
+            )
+        ):
+            self.replicas.increment_suspicion(sender)
+            return
+        fut.set_exception(WrongShardError(
+            msg.key, replica_epoch=msg.epoch, sent_epoch=self._epoch()
+        ))
 
     def _on_tag_batch_reply(self, sender: str, msg: M.TagBatchReply) -> None:
         fut, votes, digest, keys, fp = self._pending_tags[msg.nonce]
@@ -387,13 +468,14 @@ class AbdClient:
         try:
             with tracer.span("abd.read_tags", k=len(keys)):
                 t0 = time.perf_counter()
-                req = M.ReadTagBatch(tuple(keys), nonce, sig, fingerprint)
+                req = M.ReadTagBatch(tuple(keys), nonce, sig, fingerprint,
+                                     epoch=self._epoch())
                 for replica in trusted:
                     self.net.send(self.addr, replica, req)
                 vectors = await asyncio.wait_for(fut, timeout)
                 metrics.observe(
                     "dds_quorum_rtt_seconds", time.perf_counter() - t0,
-                    op="read_tags",
+                    **self._mlabels(op="read_tags"),
                     help="proxy->coordinator quorum round-trip time",
                 )
             if not keys:

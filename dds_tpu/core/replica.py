@@ -82,6 +82,7 @@ class BFTABDNode:
         supervisor: str,
         net: Transport,
         config: ReplicaConfig | None = None,
+        shard=None,
     ):
         self.addr = addr
         self.name = addr.rsplit("/", 1)[-1]
@@ -115,6 +116,13 @@ class BFTABDNode:
         # verified-reseed sessions in flight: session -> {begin, chunks}
         # (SleepBegin and StateChunks may arrive in any order)
         self._recovery_sessions: dict[int, dict] = {}
+        # Constellation: the group's shared fencing state (shard.ShardState
+        # duck-type: group_id / epoch / owns(key)). None = unsharded, no
+        # fencing. Shard-migration sessions buffer separately from
+        # recovery reseeds — completing one must never replace the
+        # repository or flip behavior.
+        self.shard = shard
+        self._migrate_sessions: dict[int, dict] = {}
         # last snapshot save/load bookkeeping (core/snapshot fills it;
         # exported via /health + scrape-time gauges)
         self.snapshot_meta: dict = {}
@@ -169,6 +177,31 @@ class BFTABDNode:
         self._tagbatch_cache.clear()
         self.merkle.rebuild({})
         self._recovery_sessions.clear()
+
+    def _shard_fenced(self, key: str) -> bool:
+        """True when this group must NOT serve `key` under its current
+        shard map (Constellation epoch fencing). Unsharded nodes never
+        fence."""
+        return self.shard is not None and not self.shard.owns(key)
+
+    def _reply_wrong_shard(self, dest: str, key: str, nonce: int,
+                           sent_epoch: int, what: str) -> None:
+        """Typed, signed fence rejection: tells the proxy its map is
+        stale (or a reshard is in flight) so it refreshes and re-routes
+        under its existing Deadline budget — the no-silent-misroutes leg
+        of a live reshard."""
+        epoch = self.shard.epoch
+        sig = sigs.proxy_signature(
+            self.cfg.proxy_mac_secret, key, nonce, ["wrong-shard", epoch]
+        )
+        metrics.inc(
+            "dds_shard_fenced_total", shard=str(self.shard.group_id),
+            msg=what,
+            help="requests fenced for keys outside the group's shard map",
+        )
+        tracer.event("shard.fence", replica=self.name, key=key,
+                     epoch=epoch, sent_epoch=sent_epoch, msg=what)
+        self._send(dest, M.WrongShard(key, epoch, nonce, sig))
 
     def _tag_batch_fill(self, keys: tuple, digest: str) -> tuple[tuple, bytes]:
         """(tag vector, fingerprint) for an AUTHENTICATED ReadTagBatch,
@@ -241,6 +274,15 @@ class BFTABDNode:
                             cfg.proxy_mac_secret, key, nonce, signature
                         ):
                             self._debug("invalid proxy signature")
+                        elif self._shard_fenced(key):
+                            # fence AFTER authentication (an unauthenticated
+                            # probe must not learn the keyspace layout) and
+                            # burn the request so a replay cannot re-ask
+                            req.expired = True
+                            self._reply_wrong_shard(
+                                sender, key, nonce + cfg.nonce_increment,
+                                msg.epoch, "IRead",
+                            )
                         else:
                             self._broadcast(M.Read(key, nonce))
                     case M.IWrite(key, value):
@@ -248,6 +290,12 @@ class BFTABDNode:
                             cfg.proxy_mac_secret, key, nonce, signature, value
                         ):
                             self._debug("invalid proxy signature")
+                        elif self._shard_fenced(key):
+                            req.expired = True
+                            self._reply_wrong_shard(
+                                sender, key, nonce + cfg.nonce_increment,
+                                msg.epoch, "IWrite",
+                            )
                         else:
                             req.set_to_write = value
                             self._broadcast(M.ReadTag(key, nonce))
@@ -289,6 +337,17 @@ class BFTABDNode:
                     self._debug("invalid nonce - repeated (tag batch)")
                     self._suspect(sender)
                     return
+                if self.shard is not None:
+                    bad = next(
+                        (k for k in keys if self._shard_fenced(k)), None
+                    )
+                    if bad is not None:
+                        # batch replies correlate by the REQUEST nonce
+                        self.incoming[nonce] = True
+                        self._reply_wrong_shard(
+                            sender, bad, nonce, msg.epoch, "ReadTagBatch"
+                        )
+                        return
                 if hit is not None:
                     tags, fp = hit[2], hit[3]
                 else:
@@ -364,6 +423,21 @@ class BFTABDNode:
                     self._debug("invalid nonce - expired at Write (late quorum reply)")
                     return
                 self.incoming[nonce] = True
+                if self._shard_fenced(key):
+                    # storage-layer fence: a Write minted under a stale
+                    # epoch (coordinator raced the map install) is neither
+                    # stored nor acked — the op can't reach quorum, the
+                    # client retries, and the retry fences at the
+                    # coordinator. Zero stale-epoch writes ever land.
+                    metrics.inc(
+                        "dds_shard_fenced_total",
+                        shard=str(self.shard.group_id), msg="Write",
+                        help="requests fenced for keys outside the group's "
+                             "shard map",
+                    )
+                    tracer.event("shard.fence", replica=self.name, key=key,
+                                 epoch=self.shard.epoch, msg="Write")
+                    return
                 cur_tag, _ = self._state(key)
                 if cur_tag < tag:
                     self._store(key, tag, value)
@@ -507,8 +581,17 @@ class BFTABDNode:
                 self._send(sender, M.Complying())
                 self.behavior = "sentinent"
 
-            case M.SleepBegin() | M.StateChunk():
+            case M.SleepBegin():
                 self._recovery_ingest(sender, msg)
+
+            case M.ShardMigrateBegin():
+                self._migrate_ingest(sender, msg)
+
+            case M.StateChunk():
+                if msg.kind == "migrate":
+                    self._migrate_ingest(sender, msg)
+                else:
+                    self._recovery_ingest(sender, msg)
 
             case M.StateDigestRequest(nonce):
                 manifest = self.merkle.manifest()
@@ -549,6 +632,8 @@ class BFTABDNode:
                     self._debug("invalid nonce - repeated (sentinent)")
                     return
                 self.incoming[nonce] = True
+                if self._shard_fenced(key):
+                    return  # same storage fence as the healthy path
                 cur_tag, _ = self._state(key)
                 if cur_tag < tag:
                     self._store(key, tag, value)
@@ -577,6 +662,14 @@ class BFTABDNode:
                 # spares sync too: a snapshot-restored sentinent converges
                 # before it is ever promoted
                 self.antientropy.handle(sender, msg)
+
+            case M.ShardMigrateBegin():
+                # spares of a NEW group ingest the migration too, so a
+                # later promotion starts warm instead of divergent
+                self._migrate_ingest(sender, msg)
+
+            case M.StateChunk() if msg.kind == "migrate":
+                self._migrate_ingest(sender, msg)
 
             case M.Kill():
                 self._wipe()
@@ -711,36 +804,95 @@ class BFTABDNode:
         self.behavior = "sentinent"
 
     def _verified_manifest(self, digests: list, support: int) -> dict:
-        """Cross-check the relayed manifest quorum: verify every HMAC (the
-        signer address is bound into it, so a relay cannot re-attribute)
-        and keep only entries attested identically by >= `support` (= f+1)
-        distinct signers — at least one of which is then honest, so no
-        single Byzantine spare or relay can smuggle a forged entry."""
-        votes: dict[tuple, set] = {}
-        for item in digests:
-            try:
-                signer, manifest, nonce, sighex = item
-                if not sigs.validate_manifest_signature(
-                    self.cfg.abd_mac_secret, str(signer), manifest,
-                    int(nonce), bytes.fromhex(sighex),
-                ):
-                    continue
-            except (TypeError, ValueError):
-                continue
-            for key, ent in manifest.items():
+        return verified_manifest(digests, support, self.cfg.abd_mac_secret)
+
+    # -------------------------------------------------- shard migration
+
+    MAX_MIGRATE_SESSIONS = 4
+
+    def _migrate_ingest(self, sender: str, msg) -> None:
+        """Buffer one frame of a Constellation key migration (header or a
+        kind="migrate" StateChunk). Same reorder-tolerant, bounded session
+        buffering as recovery — but completion MERGES, never replaces."""
+        sess = self._migrate_sessions.get(msg.session)
+        if sess is None:
+            while len(self._migrate_sessions) >= self.MAX_MIGRATE_SESSIONS:
+                self._migrate_sessions.pop(next(iter(self._migrate_sessions)))
+            sess = self._migrate_sessions[msg.session] = {
+                "begin": None, "sender": None, "chunks": {},
+            }
+        if isinstance(msg, M.ShardMigrateBegin):
+            sess["begin"] = msg
+            sess["sender"] = sender
+        else:
+            sess["chunks"][int(msg.seq)] = msg.entries
+        self._try_complete_migration(msg.session)
+
+    def _try_complete_migration(self, session: int) -> None:
+        sess = self._migrate_sessions.get(session)
+        begin = sess["begin"]
+        if begin is None:
+            return
+        chunks = sess["chunks"]
+        if sum(1 for s in chunks if 0 <= s < begin.total) < begin.total:
+            return
+        verified = self._verified_manifest(begin.digests, begin.support)
+        accepted = rejected = 0
+        for seq in range(begin.total):
+            for key, e in chunks[seq].items():
                 try:
-                    attested = (str(key), int(ent[0]), str(ent[1]), str(ent[2]))
-                except (TypeError, ValueError, IndexError):
+                    tag = M.ABDTag(int(e["tag"][0]), str(e["tag"][1]))
+                    value = e["value"]
+                except (KeyError, TypeError, ValueError, IndexError):
+                    rejected += 1
                     continue
-                votes.setdefault(attested, set()).add(str(signer))
-        verified: dict[str, tuple] = {}
-        for (key, seq, tid, vd), signers in votes.items():
-            if len(signers) < support:
-                continue
-            cur = verified.get(key)
-            if cur is None or (seq, tid) > (cur[0], cur[1]):
-                verified[key] = (seq, tid, vd)
-        return verified
+                # the receiving group only takes keys its OWN map assigns
+                # it — a Byzantine rebalancer cannot use a migration to
+                # park foreign keys on this group
+                if self.shard is not None and not self.shard.owns(key):
+                    rejected += 1
+                    continue
+                want = verified.get(key)
+                if want != (tag.seq, tag.id, sigs.value_digest(value)):
+                    rejected += 1
+                    continue
+                cur_tag = self.repository.get(key, (M.ABDTag(0, self.name),
+                                                    None))[0]
+                if cur_tag < tag:
+                    self._store(key, tag, value)
+                accepted += 1  # installed, or already at/above the attested tag
+        self._migrate_sessions.pop(session, None)
+        metrics.inc(
+            "dds_shard_migrated_keys_total", accepted, replica=self.name,
+            help="verified keys accepted during shard migrations",
+        )
+        if rejected:
+            tracer.event("shard.migrate_rejected", replica=self.name,
+                         rejected=rejected, accepted=accepted)
+            flight.record(
+                "shard_migrate_rejected", replica=self.name,
+                rejected=rejected, accepted=accepted, session=session,
+            )
+        self._debug(
+            f"shard migration {session}: {accepted} accepted, "
+            f"{rejected} rejected"
+        )
+        self._send(sess["sender"], M.ShardMigrateAck(session, accepted,
+                                                     rejected))
+
+    def drop_unowned(self) -> int:
+        """Prune repository entries outside this group's shard map (after
+        a migration activates). Returns the number of keys dropped."""
+        if self.shard is None:
+            return 0
+        doomed = [k for k in self.repository if not self.shard.owns(k)]
+        for k in doomed:
+            del self.repository[k]
+        if doomed:
+            self.repo_version += 1
+            self._tagbatch_cache.clear()
+            self.merkle.rebuild(self.repository)
+        return len(doomed)
 
     # ---------------------------------------------------------------- admin
 
@@ -748,3 +900,38 @@ class BFTABDNode:
         return {
             k: {"tag": [t.seq, t.id], "value": v} for k, (t, v) in self.repository.items()
         }
+
+
+def verified_manifest(digests: list, support: int, secret: bytes) -> dict:
+    """Cross-check a relayed manifest quorum: verify every HMAC (the
+    signer address is bound into it, so a relay cannot re-attribute)
+    and keep only entries attested identically by >= `support` (= f+1)
+    distinct signers — at least one of which is then honest, so no
+    single Byzantine spare or relay can smuggle a forged entry. Shared
+    by verified recovery reseeds, shard-migration ingest, and the
+    rebalancer's source-side planning (shard/rebalance)."""
+    votes: dict[tuple, set] = {}
+    for item in digests:
+        try:
+            signer, manifest, nonce, sighex = item
+            if not sigs.validate_manifest_signature(
+                secret, str(signer), manifest,
+                int(nonce), bytes.fromhex(sighex),
+            ):
+                continue
+        except (TypeError, ValueError):
+            continue
+        for key, ent in manifest.items():
+            try:
+                attested = (str(key), int(ent[0]), str(ent[1]), str(ent[2]))
+            except (TypeError, ValueError, IndexError):
+                continue
+            votes.setdefault(attested, set()).add(str(signer))
+    verified: dict[str, tuple] = {}
+    for (key, seq, tid, vd), signers in votes.items():
+        if len(signers) < support:
+            continue
+        cur = verified.get(key)
+        if cur is None or (seq, tid) > (cur[0], cur[1]):
+            verified[key] = (seq, tid, vd)
+    return verified

@@ -92,6 +92,13 @@ class BFTSupervisor:
         self._pending: dict[str, asyncio.Future] = {}
         self._task: Optional[asyncio.Task] = None
         self._recovering: set[str] = set()  # endpoints with recovery in flight
+        # recovery-complete hook: set whenever NO recovery is in flight.
+        # Event-driven waiters (tests, graceful stop) use this instead of
+        # sleeping-and-hoping — cancelling a recovery mid-swap tears
+        # membership (spare promoted, offender not yet demoted).
+        self._idle = asyncio.Event()
+        self._idle.set()
+        self._inflight: Optional[asyncio.Task] = None  # proactive recover task
         # consecutive unreachability strikes (Awake / post-redeploy Sleep
         # timeouts). One timeout may be transient (slow restart, supervisor-
         # side blip), so nodes are only DROPPED from membership after
@@ -117,6 +124,27 @@ class BFTSupervisor:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        # graceful: a recovery the loop had in flight keeps running under
+        # the shield below — await it so stop() never tears membership
+        # mid-swap (promoted spare without the offender demoted). Bounded
+        # by the recovery path's own timeouts.
+        inflight = self._inflight
+        if inflight is not None and not inflight.done():
+            try:
+                await inflight
+            except Exception:  # recovery failures are already logged
+                pass
+        self._inflight = None
+
+    async def wait_recovery_idle(self, timeout: float = 10.0) -> bool:
+        """Event-driven recovery-complete hook: resolves once no recovery
+        (proactive OR suspicion-quorum-driven) is in flight. Returns False
+        on timeout instead of raising — callers decide how loud to be."""
+        try:
+            await asyncio.wait_for(self._idle.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
 
     async def _proactive_loop(self) -> None:
         await asyncio.sleep(self.cfg.proactive_recovery_warmup)
@@ -125,7 +153,15 @@ class BFTSupervisor:
                 oldest, _ = min(self.active, key=lambda r: r[1])
                 if self.cfg.debug:
                     log.info("proactively recovering %s", oldest)
-                await self.recover(oldest)
+                # shield: cancelling this loop (stop()) must not cancel a
+                # swap mid-flight — stop() awaits the task instead
+                rec = asyncio.ensure_future(self.recover(oldest))
+                self._inflight = rec
+                try:
+                    await asyncio.shield(rec)
+                finally:
+                    if rec.done():
+                        self._inflight = None
             await asyncio.sleep(self.cfg.proactive_recovery_interval)
 
     # ------------------------------------------------------------- messages
@@ -353,6 +389,7 @@ class BFTSupervisor:
             log.warning("refusing to recover non-active endpoint %s", byzantine)
             return
         self._recovering.add(byzantine)
+        self._idle.clear()
         spare = None
         tried: set[str] = set()
         with tracer.span("supervisor.recover", victim=byzantine) as span:
@@ -463,3 +500,5 @@ class BFTSupervisor:
                 self._recovering.discard(byzantine)
                 if spare is not None:
                     self._recovering.discard(spare)
+                if not self._recovering:
+                    self._idle.set()

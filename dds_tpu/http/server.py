@@ -34,7 +34,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from dds_tpu.core.errors import ByzantineError
+from dds_tpu.core.errors import ByzantineError, WrongShardError
 from dds_tpu.core.quorum_client import AbdClient
 from dds_tpu.http import json_protocol as J
 from dds_tpu.http.miniserver import HttpServer, Request, Response, http_request
@@ -64,8 +64,13 @@ _REQ_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
 )
 
 # transient storage-layer failures worth retrying; anything else (a
-# programming error, a bad request) propagates immediately
-_RETRYABLE = (ByzantineError, asyncio.TimeoutError, NoTrustedNodesError, OSError)
+# programming error, a bad request) propagates immediately.
+# WrongShardError is the Constellation fence: the router refreshes its
+# shard map and the retry re-resolves the owner — during a live reshard
+# the op stalls inside its Deadline budget until the new map activates,
+# then lands on the new group. Never a silent misroute.
+_RETRYABLE = (ByzantineError, WrongShardError, asyncio.TimeoutError,
+              NoTrustedNodesError, OSError)
 
 
 @dataclass
@@ -220,6 +225,12 @@ class DDSRestServer:
         self._fold_pending: dict[int, list] = {}
         self._fold_drainer: asyncio.Task | None = None
         self._folds_inflight = 0  # folds currently executing (any path)
+        # Constellation: a ShardRouter (duck-typed via its shard_manager)
+        # turns point routes into one-group ops and aggregates into
+        # scatter-gather per-shard folds; a plain AbdClient leaves every
+        # path exactly as before
+        self._shards = getattr(abd, "shard_manager", None)
+        self._scatter_memo: tuple | None = None  # pairs identity -> shard operands
 
     # ------------------------------------------------------------ lifecycle
 
@@ -912,7 +923,14 @@ class DDSRestServer:
                     n for n in trusted
                     if n not in self.abd.breakers or self.abd.breakers[n].allow()
                 ]
-                degraded = len(reachable) < self.abd.cfg.quorum_size
+                shards = None
+                if self._shards is not None:
+                    # sharded: the merged replica pool says nothing about
+                    # quorum health — each GROUP must hold its own quorum
+                    shards = self.abd.shards_health()
+                    degraded = any(s["degraded"] for s in shards.values())
+                else:
+                    degraded = len(reachable) < self.abd.cfg.quorum_size
                 health = {
                     "status": "degraded" if degraded else "ok",
                     "active_replicas": len(trusted),
@@ -922,6 +940,10 @@ class DDSRestServer:
                     "stored_keys": len(self.stored_keys),
                     "request_budget": self.cfg.request_budget,
                 }
+                if shards is not None:
+                    health["shards"] = shards
+                    health["shard_epoch"] = self._shards.epoch
+                    health["reshard_state"] = self._shards.state
                 recovery = self._recovery_status()
                 if recovery is not None:
                     health["recovery"] = recovery
@@ -943,6 +965,13 @@ class DDSRestServer:
                     metrics.render().encode(),
                     content_type="text/plain; version=0.0.4; charset=utf-8",
                 )
+
+            case ("GET", "shards") if self._shards is not None:
+                # operator inspection: the ACTIVE signed map (epoch +
+                # HMAC, verifiable against the intranet secret), reshard
+                # state, and per-group membership. Always on when sharded
+                # — like /health it reveals topology, not workload shape.
+                return Response.json(self.abd.status())
 
             case ("GET", "slo") if self.cfg.slo_route_enabled:
                 # per-route objective/burn state (obs/slo) plus the
@@ -991,6 +1020,25 @@ class DDSRestServer:
         )
         metrics.set("dds_stored_keys", len(self.stored_keys),
                     help="aggregate key-set size")
+        if self._shards is not None:
+            smap = self._shards.current()
+            metrics.set("dds_shard_epoch", smap.epoch,
+                        help="active shard-map epoch")
+            metrics.set(
+                "dds_shard_reshard_state",
+                1 if self._shards.state == "resharding" else 0,
+                help="0=stable 1=resharding",
+            )
+            metrics.set("dds_shard_groups", len(smap.groups),
+                        help="quorum groups in the active shard map")
+            counts = {g: 0 for g in smap.groups}
+            for k in self.stored_keys:  # the proxy's aggregate-key view
+                counts[smap.owner(k)] = counts.get(smap.owner(k), 0) + 1
+            for gid, n in counts.items():
+                metrics.set(
+                    "dds_shard_keys", n, shard=gid,
+                    help="stored aggregate keys per shard (proxy view)",
+                )
         # SLO burn/budget gauges + audit backlog (scrape-time freshness is
         # all a gauge promises; the violation COUNTER increments at
         # detection time in the auditor itself)
@@ -1106,15 +1154,40 @@ class DDSRestServer:
         )
         if mod:
             modulus = self._parse_modulus(mod, modparam)
-            # device-resident path when the backend has a cipher store:
-            # quorum/tag validation above is still authoritative; the store
-            # only memoizes limb conversion + transfer (ops/store.py).
-            # The fold runs in a worker thread so concurrent aggregate
-            # requests overlap their device dispatches (and the event loop
-            # keeps serving) instead of serializing on a blocking fetch.
-            with tracer.span("proxy.fold", k=len(operands),
-                             backend=self.backend.name):
-                result = await self._fold(operands, modulus)
+            shard_ops = (
+                self._shard_operands(pairs, pos)
+                if self._shards is not None else None
+            )
+            if shard_ops is not None and len(shard_ops) > 1:
+                # Constellation scatter-gather: one coalescable fold per
+                # shard, dispatched CONCURRENTLY so they share a single
+                # segmented foldmany device dispatch (the coalescing
+                # window sees them in flight together), then the partials
+                # merge with the mesh plane's modular-product tail combine
+                # — all shards share one Paillier modulus, so the result
+                # is bit-identical to the unsharded fold.
+                from dds_tpu.parallel.mesh import combine_partials
+
+                with tracer.span("proxy.scatter_fold", k=len(operands),
+                                 shards=len(shard_ops),
+                                 backend=self.backend.name):
+                    partials = await asyncio.gather(
+                        *(self._fold(g, modulus) for g in shard_ops)
+                    )
+                    result = combine_partials(
+                        [int(p) for p in partials], modulus
+                    )
+            else:
+                # device-resident path when the backend has a cipher store:
+                # quorum/tag validation above is still authoritative; the
+                # store only memoizes limb conversion + transfer
+                # (ops/store.py). The fold runs in a worker thread so
+                # concurrent aggregate requests overlap their device
+                # dispatches (and the event loop keeps serving) instead of
+                # serializing on a blocking fetch.
+                with tracer.span("proxy.fold", k=len(operands),
+                                 backend=self.backend.name):
+                    result = await self._fold(operands, modulus)
         elif modparam == "nsqr":
             result = sum(operands)
         else:
@@ -1122,6 +1195,21 @@ class DDSRestServer:
             for o in operands:
                 result *= o
         return Response.json(J.value_result(str(result)))
+
+    def _shard_operands(self, pairs, pos: int) -> list[list[int]]:
+        """Aggregate operands partitioned by owning shard group (memoized
+        per pairs-identity like the flat operand memo — between writes the
+        partition is state-identical)."""
+        memo = self._scatter_memo
+        if memo is not None and memo[0] is pairs and memo[1] == pos:
+            return memo[2]
+        groups: dict[str, list[int]] = {}
+        for k, v in pairs:
+            if pos < len(v):
+                groups.setdefault(self.abd.owner(k), []).append(int(v[pos]))
+        out = [g for g in groups.values() if g]
+        self._scatter_memo = (pairs, pos, out)
+        return out
 
     def _backend_fold_fn(self):
         """The backend's single-aggregate fold entry point (the

@@ -143,17 +143,39 @@ class Watchtower:
         quorum_size: int | None = None,
         n_replicas: int | None = None,
         check_quorum: bool | None = None,
+        group_geometry: dict | None = None,
     ) -> None:
-        """Late wiring from a deployment config (run.launch)."""
+        """Late wiring from a deployment config (run.launch).
+
+        `group_geometry` maps a Constellation group id (the replica-name
+        prefix, e.g. "s0" for "s0-replica-3") to that group's (quorum
+        size, active replica count): a sharded deployment's ops are
+        audited against the geometry of the GROUP whose replicas served
+        them, not a global q/n — heterogeneous groups audit correctly."""
         if quorum_size is not None:
             self.quorum_size = quorum_size
         if n_replicas is not None:
             self.n_replicas = n_replicas
         if check_quorum is not None:
             self.check_quorum = check_quorum
+        if group_geometry is not None:
+            self.group_geometry = dict(group_geometry)
+        elif not hasattr(self, "group_geometry"):
+            self.group_geometry = {}
         # quorum-intersection bound: any two quorums of size q out of n
         # replicas share >= 2q - n members (>= f+1 for honest quorums)
         self.intersection = max(1, 2 * self.quorum_size - self.n_replicas)
+
+    def _geometry_for(self, participants: set[str]) -> tuple[int, int]:
+        """(quorum, intersection bound) for the group that served an op,
+        resolved from the participants' name prefixes; falls back to the
+        global geometry for unsharded deployments."""
+        if self.group_geometry:
+            for name in participants:
+                for gid, (q, n) in self.group_geometry.items():
+                    if name.startswith(gid + "-"):
+                        return q, max(1, 2 * q - n)
+        return self.quorum_size, self.intersection
 
     # ------------------------------------------------------------ lifecycle
 
@@ -358,7 +380,7 @@ class Watchtower:
                 read_set.add(replica)
             elif msg in _WRITE_PHASE_MSGS:
                 write_set.add(replica)
-        q = self.quorum_size
+        q, intersection = self._geometry_for(read_set | write_set)
         is_write = op_span.name == "abd.write"
         problems = []
         if len(read_set) < q:
@@ -369,10 +391,10 @@ class Watchtower:
             problems.append(f"write_phase={len(write_set)}<{q}")
         if (
             read_set and write_set
-            and len(read_set & write_set) < self.intersection
+            and len(read_set & write_set) < intersection
         ):
             problems.append(
-                f"intersection={len(read_set & write_set)}<{self.intersection}"
+                f"intersection={len(read_set & write_set)}<{intersection}"
             )
         if problems:
             self._violate(

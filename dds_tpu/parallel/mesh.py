@@ -135,6 +135,31 @@ def _tree_reduce_local(cs, N, n0inv, one_mont):
     return t
 
 
+def combine_partials(partials, modulus: int) -> int:
+    """Modular-product tail combine over already-reduced partials — the
+    host-integer twin of the replicated log2(D) tree `sharded_reduce_mul`
+    runs over gathered per-device partials (`_tree_reduce_local`). The
+    Constellation scatter-gather path (http/server._fold_aggregate) uses
+    it to merge per-shard aggregate folds: every shard group shares one
+    Paillier modulus, and the modular product is associative/commutative,
+    so S per-shard partials combine bit-for-bit to the single-shard
+    result regardless of how the keyspace was partitioned. Kept here, not
+    duplicated in shard/, so the two partial-combine paths stay one
+    implementation site."""
+    parts = [p % modulus for p in partials]
+    if not parts:
+        raise ValueError("combine_partials needs at least one partial")
+    while len(parts) > 1:
+        nxt = [
+            (parts[i] * parts[i + 1]) % modulus
+            for i in range(0, len(parts) - 1, 2)
+        ]
+        if len(parts) % 2:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
 def sharded_reduce_mul(ctx: ModCtx, cs, mesh: Mesh, axis: str = "batch",
                        ring: bool = False, kernel: str = "jnp"):
     """Modular product of K ciphertexts sharded over `mesh`.

@@ -48,8 +48,14 @@ class Deployment:
     trudy: Trudy
     ssl_client: object = None
     _stoppables: list = field(default_factory=list)
+    # Constellation (shard.enabled): the sharded-plane handle — per-group
+    # ShardGroup list lives on constellation.groups; `replicas` above is
+    # the merged view (snapshots / anti-entropy / health reuse it as-is)
+    constellation: object = None
 
     async def stop(self) -> None:
+        if self.constellation is not None:
+            await self.constellation.stop()
         if self.supervisor is not None:
             await self.supervisor.stop()
         await self.server.stop()
@@ -172,6 +178,19 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
 
         net = ChaosNet(net, seed=cfg.attacks.chaos_seed)
         stoppables.append(net)
+
+    if cfg.shard.enabled:
+        # Constellation: S independent quorum groups behind a shard router
+        # (single-process topologies — the shard-map install step is an
+        # in-process config push; see utils/config.ShardConfig)
+        if cfg.transport.kind != "memory":
+            raise ValueError(
+                "shard.enabled requires transport.kind = 'memory' "
+                "(multi-host shard-map distribution is future work)"
+            )
+        return await _launch_constellation(
+            cfg, net, stoppables, ssl_server, ssl_client
+        )
 
     rcfg = ReplicaConfig(
         quorum_size=cfg.replicas.byz_quorum_size,
@@ -443,6 +462,126 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             quorum_size=cfg.replicas.byz_quorum_size,
             n_replicas=n_active,
             check_quorum=cfg.obs.audit_quorum_checks and all_local,
+        )
+        watchtower.attach(_tracer)
+    return dep
+
+
+async def _launch_constellation(cfg: DDSConfig, net, stoppables,
+                                ssl_server, ssl_client) -> Deployment:
+    """shard.enabled boot: S quorum groups + ShardRouter behind the proxy.
+
+    Each group mirrors the single-group stack (replicas, spares,
+    supervisor, anti-entropy, Trudy) with namespaced endpoints over the
+    one transport; the REST server talks to the ShardRouter, which routes
+    point ops by the signed epoch-versioned ShardMap and scatter-gathers
+    aggregates. The Watchtower audits every group against ITS OWN quorum
+    geometry via the per-group geometry table."""
+    from dds_tpu.shard import build_constellation
+
+    sh = cfg.shard
+    rcfg = ReplicaConfig(
+        quorum_size=sh.quorum_size,
+        nonce_increment=cfg.security.nonce_challenge_increment,
+        abd_mac_secret=cfg.security.abd_mac_secret.encode(),
+        proxy_mac_secret=cfg.security.proxy_mac_secret.encode(),
+        debug=cfg.debug,
+        allow_fault_injection=cfg.attacks.enabled,
+    )
+    sup_cfg = SupervisorConfig(
+        quorum_size=sh.quorum_size,
+        proactive_recovery_warmup=cfg.recovery.warm_up,
+        proactive_recovery_interval=cfg.recovery.interval,
+        sentinent_awake_timeout=cfg.recovery.sentinent_awake_timeout,
+        crashed_recovery_timeout=cfg.recovery.crashed_recovery_timeout,
+        proactive_recovery_enabled=cfg.recovery.enabled,
+        verified_transfer=cfg.recovery.verified_transfer,
+        manifest_timeout=cfg.recovery.manifest_timeout,
+        state_chunk_keys=cfg.recovery.state_chunk_keys,
+        abd_mac_secret=cfg.security.abd_mac_secret.encode(),
+        debug=cfg.debug,
+    )
+    abd_cfg = AbdClientConfig(
+        proxy_mac_secret=cfg.security.proxy_mac_secret.encode(),
+        nonce_increment=cfg.security.nonce_challenge_increment,
+        request_timeout=cfg.proxy.intranet_request_timeout,
+        abd_mac_secret=cfg.security.abd_mac_secret.encode(),
+        quorum_size=sh.quorum_size,
+        breaker_threshold=cfg.proxy.breaker_threshold,
+        breaker_reset=cfg.proxy.breaker_reset,
+    )
+    const = build_constellation(
+        net,
+        shard_count=sh.count,
+        vnodes_per_group=sh.vnodes_per_group,
+        secret=cfg.security.abd_mac_secret.encode(),
+        manifest_timeout=sh.manifest_timeout,
+        ack_timeout=sh.ack_timeout,
+        chunk_keys=sh.migrate_chunk_keys,
+        n_active=sh.replicas_per_group,
+        n_sentinent=sh.sentinent_per_group,
+        quorum=sh.quorum_size,
+        max_faults=sh.max_faults,
+        rcfg=rcfg,
+        sup_cfg=sup_cfg,
+        abd_cfg=abd_cfg,
+        chaos=cfg.attacks.chaos_enabled,
+    )
+    replicas: dict[str, BFTABDNode] = {}
+    for g in const.groups:
+        replicas.update(g.replicas)
+
+    if cfg.recovery.enabled:
+        for g in const.groups:
+            g.supervisor.start()
+    if cfg.recovery.anti_entropy_enabled:
+        for node in replicas.values():
+            node.antientropy.configure(
+                interval=cfg.recovery.anti_entropy_interval,
+                jitter=cfg.recovery.anti_entropy_jitter,
+            )
+            node.antientropy.start()
+
+    server = DDSRestServer(
+        const.router,
+        ProxyConfig(
+            host=cfg.proxy.host,
+            port=cfg.proxy.port,
+            request_budget=cfg.proxy.request_budget,
+            retry_backoff=cfg.proxy.retry_backoff,
+            retry_max_delay=cfg.proxy.retry_max_delay,
+            retry_attempts=cfg.proxy.retry_attempts,
+            retry_after_hint=cfg.proxy.retry_after_hint,
+            handler_timeout=cfg.proxy.handler_timeout,
+            crypto_backend=cfg.proxy.crypto_backend,
+            keys_path=cfg.proxy.stored_keys_path,
+            coalesce_window=cfg.proxy.coalesce_window,
+            supervisor=const.groups[0].supervisor.addr,
+            trace_route_enabled=cfg.debug or cfg.obs.trace_route,
+            metrics_route_enabled=cfg.obs.metrics_route,
+            slo_route_enabled=cfg.obs.slo_route,
+            ssl_server_context=ssl_server,
+            ssl_client_context=ssl_client,
+        ),
+        local_replicas=replicas,
+        slo=SloEngine.from_obs(cfg.obs),
+    )
+    await server.start()
+
+    dep = Deployment(cfg, net, replicas, None, server,
+                     const.groups[0].trudy, ssl_client, stoppables,
+                     constellation=const)
+    if cfg.obs.audit_enabled:
+        from dds_tpu.obs.watchtower import watchtower
+        from dds_tpu.utils.trace import tracer as _tracer
+
+        watchtower.configure(
+            quorum_size=sh.quorum_size,
+            n_replicas=sh.replicas_per_group,
+            check_quorum=cfg.obs.audit_quorum_checks,
+            group_geometry={
+                g.gid: (g.quorum_size, len(g.active)) for g in const.groups
+            },
         )
         watchtower.attach(_tracer)
     return dep

@@ -1,0 +1,33 @@
+"""Constellation: the sharded keyspace plane.
+
+Partitions the key->set keyspace across S independent BFT-ABD quorum
+groups — each with its own replicas, spares, supervisor, anti-entropy
+loop, and attack surface — behind a consistent-hash, epoch-versioned,
+HMAC-signed `ShardMap` that every client->replica message carries and
+every replica fences. Point ops route to exactly one group; aggregates
+scatter per-shard folds and gather partials with the mesh plane's
+modular-product tail combine. Live resharding streams keys through
+Aegis-verified state-transfer frames under an epoch fence, so a split
+never loses or misroutes a write. See DEPLOY.md "Sharding".
+"""
+
+from dds_tpu.shard.fabric import (
+    Constellation,
+    ShardGroup,
+    build_constellation,
+    build_group,
+)
+from dds_tpu.shard.rebalance import Rebalancer, ReshardAborted
+from dds_tpu.shard.router import ShardRouter
+from dds_tpu.shard.shardmap import (
+    ShardManager,
+    ShardMap,
+    ShardState,
+    moved_keys,
+)
+
+__all__ = [
+    "Constellation", "ShardGroup", "build_constellation", "build_group",
+    "Rebalancer", "ReshardAborted", "ShardRouter",
+    "ShardManager", "ShardMap", "ShardState", "moved_keys",
+]

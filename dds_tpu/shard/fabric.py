@@ -1,0 +1,181 @@
+"""Constellation fabric: build S independent BFT-ABD quorum groups.
+
+One group = the full single-shard stack the repo already had — replicas
+(+sentinent spares), a supervisor with proactive recovery, per-replica
+Merkle anti-entropy, an `AbdClient`, and a Trudy/Nemesis attack surface —
+instantiated per group with namespaced endpoints (`s0-replica-3`,
+`s1-supervisor`, ...) over ONE shared transport (so ChaosNet schedules,
+partitions, and Nemesis attacks apply to any subset of the constellation).
+`build_constellation` assembles S groups plus the ShardManager/ShardRouter
+pair and a Rebalancer; `build_group` is the per-group factory the live
+split uses to bring up a brand-new group mid-flight.
+
+Used by run.launch (config-driven), the shard test suite, and
+benchmarks/shard_scaling.py — one topology builder, three consumers.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
+from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
+from dds_tpu.core.supervisor import BFTSupervisor, SupervisorConfig
+from dds_tpu.shard.rebalance import Rebalancer
+from dds_tpu.shard.router import ShardRouter
+from dds_tpu.shard.shardmap import ShardManager, ShardMap, ShardState
+
+
+@dataclass
+class ShardGroup:
+    """Handle to one quorum group of the constellation."""
+
+    gid: str
+    active: list[str]
+    sentinent: list[str]
+    replicas: dict[str, BFTABDNode]
+    supervisor: BFTSupervisor
+    client: AbdClient
+    state: ShardState
+    quorum_size: int
+    trudy: object = None
+
+    def all_replicas(self) -> list[str]:
+        return self.active + self.sentinent
+
+    def export_from(self, endpoint: str) -> dict:
+        """Export one replica's repository (migration seed DATA — every
+        receiver re-verifies entries against the manifest quorum)."""
+        node = self.replicas.get(endpoint)
+        return node.export_state() if node is not None else {}
+
+    def prune_unowned(self) -> int:
+        return sum(n.drop_unowned() for n in self.replicas.values())
+
+    async def stop(self) -> None:
+        await self.supervisor.stop()
+        for n in self.replicas.values():
+            await n.antientropy.stop()
+
+
+@dataclass
+class Constellation:
+    manager: ShardManager
+    router: ShardRouter
+    groups: list[ShardGroup]
+    rebalancer: Rebalancer
+    net: object = None
+    secret: bytes = b""
+    _build_kwargs: dict = field(default_factory=dict)
+
+    def group(self, gid: str) -> ShardGroup:
+        return next(g for g in self.groups if g.gid == gid)
+
+    async def split(self, victim_gid: str) -> ShardGroup:
+        """Live split: bring up a fresh group, migrate ~half of the
+        victim's keyspace into it (Aegis-verified, epoch-fenced), activate.
+        The new group fences everything until activation, so it can be
+        built eagerly without receiving traffic."""
+        new_gid = f"s{len(self.groups)}"
+        old_map = self.manager.current()
+        state = ShardState(new_gid, old_map, self.secret)
+        group = build_group(self.net, new_gid, state, **self._build_kwargs)
+        victim = self.group(victim_gid)
+        await self.rebalancer.split(victim, group)
+        self.groups.append(group)
+        self.router.clients[new_gid] = group.client
+        group.client.shard_epoch = lambda m=self.manager: m.current().epoch
+        if not group.client.cfg.shard:
+            group.client.cfg.shard = new_gid
+        return group
+
+    async def stop(self) -> None:
+        for g in self.groups:
+            await g.stop()
+
+
+def build_group(
+    net,
+    gid: str,
+    state: ShardState,
+    *,
+    n_active: int = 4,
+    n_sentinent: int = 1,
+    quorum: int = 3,
+    max_faults: int = 1,
+    rcfg: ReplicaConfig | None = None,
+    sup_cfg: SupervisorConfig | None = None,
+    abd_cfg: AbdClientConfig | None = None,
+    chaos: bool = False,
+    rng: random.Random | None = None,
+) -> ShardGroup:
+    """One namespaced quorum group over `net`, fencing under `state`."""
+    import dataclasses as _dc
+
+    rcfg = rcfg or ReplicaConfig(quorum_size=quorum)
+    endpoints = [f"{gid}-replica-{i}" for i in range(n_active + n_sentinent)]
+    active, sentinent = endpoints[:n_active], endpoints[n_active:]
+    sup_addr = f"{gid}-supervisor"
+    replicas = {
+        e: BFTABDNode(e, endpoints, sup_addr, net, rcfg, shard=state)
+        for e in endpoints
+    }
+    for e in sentinent:
+        replicas[e].behavior = "sentinent"
+    supervisor = BFTSupervisor(
+        sup_addr, active, sentinent, net,
+        sup_cfg or SupervisorConfig(quorum_size=quorum,
+                                    proactive_recovery_enabled=False),
+        rng=rng,
+    )
+    if abd_cfg is None:
+        abd_cfg = AbdClientConfig(quorum_size=quorum)
+    elif not abd_cfg.shard:
+        abd_cfg = _dc.replace(abd_cfg)
+    abd_cfg.shard = gid
+    abd_cfg.supervisor = sup_addr
+    client = AbdClient(f"{gid}-proxy", net, active, abd_cfg)
+    if chaos:
+        from dds_tpu.malicious.trudy import Nemesis
+
+        trudy = Nemesis(net, active, max_faults, addr=f"{gid}-trudy", rng=rng)
+    else:
+        from dds_tpu.malicious.trudy import Trudy
+
+        trudy = Trudy(net, active, max_faults, addr=f"{gid}-trudy", rng=rng)
+    return ShardGroup(gid, active, sentinent, replicas, supervisor, client,
+                      state, quorum, trudy)
+
+
+def build_constellation(
+    net,
+    *,
+    shard_count: int = 2,
+    vnodes_per_group: int = 16,
+    secret: bytes = b"intranet-abd-secret",
+    manifest_timeout: float = 2.0,
+    ack_timeout: float = 5.0,
+    chunk_keys: int = 256,
+    prune: bool = True,
+    seed: int | None = None,
+    **group_kwargs,
+) -> Constellation:
+    """S homogeneous groups + manager/router/rebalancer over one fabric."""
+    gids = [f"s{i}" for i in range(shard_count)]
+    smap = ShardMap.build(gids, vnodes_per_group).sign(secret)
+    manager = ShardManager(smap, secret)
+    rng = random.Random(seed) if seed is not None else None
+    groups = []
+    for gid in gids:
+        state = ShardState(gid, smap, secret)
+        grp_rng = random.Random(rng.getrandbits(64)) if rng else None
+        groups.append(build_group(net, gid, state, rng=grp_rng,
+                                  **group_kwargs))
+    router = ShardRouter(manager, {g.gid: g.client for g in groups})
+    rebalancer = Rebalancer(
+        manager, net, secret, manifest_timeout=manifest_timeout,
+        ack_timeout=ack_timeout, chunk_keys=chunk_keys, prune=prune,
+    )
+    return Constellation(manager, router, groups, rebalancer, net=net,
+                         secret=secret, _build_kwargs=dict(group_kwargs))

@@ -1,0 +1,249 @@
+"""Live resharding: epoch-fenced, Byzantine-verified key migration.
+
+Splitting a shard group is a five-step protocol built from pieces the
+stack already trusts — epoch fencing (shard/shardmap + core/replica) and
+Aegis verified state transfer (StateDigest manifests, chunked streaming,
+>= f+1 distinct-signer attestation):
+
+1. **plan**   — derive the epoch+1 map (`ShardMap.split`) and sign it.
+2. **freeze** — install the new map on the SOURCE and TARGET groups'
+   fencing state. From this instant every write to a moving key is
+   fenced (coordinator Envelope check + storage-layer Write check), so
+   the moving slice of the keyspace is immutable while it is copied;
+   clients retry under their Deadline budgets and land on the new group
+   after activation. The router still serves the OLD map — unmoved keys
+   see zero disruption.
+3. **attest** — collect a quorum of HMAC-signed state manifests from the
+   source group (the same frames recovery uses). Fewer than `support`
+   (= f+1) attestations aborts: an unverifiable migration never ships.
+4. **stream** — export the moving keys from the best-attested source
+   replica (data, not truth) and stream ShardMigrateBegin + bounded
+   StateChunk(kind="migrate") frames to EVERY target replica, which
+   installs only entries attested by >= f+1 distinct signers and owned
+   under ITS map, store-if-newer. A quorum of acks each accepting the
+   full verified set is required — a Byzantine source replica that
+   withholds or corrupts entries fails the ack bar and aborts.
+5. **activate** — the router's ShardManager adopts the new map (clients
+   route to the new group), the source group prunes its moved keys, and
+   the target group's own Merkle anti-entropy loop repairs any replica
+   that missed chunks (e.g. partitioned mid-migration).
+
+Any failure rolls the fencing state back to the old map (force install),
+records a `reshard_abort` flight incident + metric, and raises
+`ReshardAborted` — the keyspace is exactly as before, minus the brief
+write stall on the moving slice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dds_tpu.core import messages as M
+from dds_tpu.core.replica import verified_manifest
+from dds_tpu.obs.flight import flight
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.utils import sigs
+from dds_tpu.utils.trace import tracer
+
+log = logging.getLogger("dds.shard.rebalance")
+
+
+class ReshardAborted(RuntimeError):
+    """A live split failed safely: the old map is back in force."""
+
+
+class Rebalancer:
+    def __init__(self, manager, net, abd_mac_secret: bytes,
+                 addr: str = "rebalancer", manifest_timeout: float = 2.0,
+                 ack_timeout: float = 5.0, chunk_keys: int = 256,
+                 prune: bool = True):
+        self.manager = manager
+        self.net = net
+        self.secret = abd_mac_secret
+        self.addr = addr
+        self.manifest_timeout = manifest_timeout
+        self.ack_timeout = ack_timeout
+        self.chunk_keys = chunk_keys
+        # pruning the source group's moved keys after activation is the
+        # production default; tests keep the pre-split state around to
+        # assert zero stale-epoch writes ever landed there
+        self.prune = prune
+        # nonce -> (future, sender -> StateDigest, target count)
+        self._manifest_collects: dict[int, tuple] = {}
+        # session -> (future, sender -> ShardMigrateAck, needed)
+        self._ack_collects: dict[int, tuple] = {}
+        net.register(addr, self._handle)
+
+    async def _handle(self, sender: str, msg) -> None:
+        if isinstance(msg, M.StateDigest):
+            coll = self._manifest_collects.get(msg.nonce)
+            if coll is None:
+                return
+            fut, votes, target = coll
+            if sender in votes:
+                return
+            if not sigs.validate_manifest_signature(
+                self.secret, sender, msg.manifest, msg.nonce, msg.signature
+            ):
+                log.warning("dropping StateDigest with bad HMAC from %s",
+                            sender)
+                return
+            votes[sender] = msg
+            if len(votes) >= target and not fut.done():
+                fut.set_result(None)
+        elif isinstance(msg, M.ShardMigrateAck):
+            coll = self._ack_collects.get(msg.session)
+            if coll is None:
+                return
+            fut, acks, needed = coll
+            acks[sender] = msg
+            if len(acks) >= needed and not fut.done():
+                fut.set_result(None)
+
+    # ------------------------------------------------------------- manifest
+
+    async def _collect_manifests(self, replicas: list[str],
+                                 quorum: int) -> dict:
+        nonce = sigs.generate_nonce()
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        votes: dict[str, M.StateDigest] = {}
+        self._manifest_collects[nonce] = (fut, votes,
+                                          min(len(replicas), quorum))
+        for r in replicas:
+            self.net.send(self.addr, r, M.StateDigestRequest(nonce))
+        try:
+            await asyncio.wait_for(fut, self.manifest_timeout)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            self._manifest_collects.pop(nonce, None)
+        return votes
+
+    # ---------------------------------------------------------------- split
+
+    async def split(self, source, target) -> "object":
+        """Split `source`'s keyspace, moving ~half to `target` (both are
+        shard.fabric.ShardGroup handles). Returns the activated ShardMap;
+        raises ReshardAborted with the old map restored on any failure."""
+        old_map = self.manager.current()
+        new_map = old_map.split(source.gid, target.gid).sign(self.secret)
+        support = max(1, 2 * source.quorum_size - len(source.active))
+
+        self.manager.begin_reshard()
+        metrics.set("dds_shard_reshard_state", 1,
+                    help="0=stable 1=resharding")
+        with tracer.span("shard.split", source=source.gid, target=target.gid,
+                         epoch=new_map.epoch) as span:
+            try:
+                # freeze: both groups fence under the NEW map from here on
+                source.state.install(new_map)
+                target.state.install(new_map)
+                smap = await self._migrate(source, target, new_map, support)
+                span["moved"] = smap
+            except ReshardAborted:
+                raise
+            except Exception as e:  # any unplanned failure aborts safely
+                self._abort(source, target, old_map, f"unexpected: {e!r}")
+            finally:
+                self.manager.end_reshard()
+                metrics.set("dds_shard_reshard_state", 0,
+                            help="0=stable 1=resharding")
+        return self.manager.current()
+
+    async def _migrate(self, source, target, new_map, support: int) -> int:
+        old_map = self.manager.current()
+        votes = await self._collect_manifests(source.active,
+                                              source.quorum_size)
+        if len(votes) < support:
+            self._abort(
+                source, target, old_map,
+                f"manifest quorum failed: {len(votes)}/{len(source.active)} "
+                f"attested (need >= {support})",
+            )
+        digests = [
+            [sender, d.manifest, d.nonce, d.signature.hex()]
+            for sender, d in votes.items()
+        ]
+        verified = verified_manifest(digests, support, self.secret)
+        moving = {
+            k: v for k, v in verified.items()
+            if new_map.owner(k) == target.gid
+        }
+
+        # seed source: the attesting replica whose manifest covers the most
+        # verified moving entries — its export is still just DATA (receivers
+        # re-verify every entry against the digest quorum)
+        def coverage(sender: str) -> int:
+            m = votes[sender].manifest
+            return sum(
+                1 for k, want in moving.items()
+                if k in m and (int(m[k][0]), str(m[k][1]), str(m[k][2]))
+                == want
+            )
+
+        seeder = max(votes, key=coverage) if votes else None
+        exported = source.export_from(seeder) if seeder else {}
+        entries = {k: e for k, e in exported.items() if k in moving}
+
+        session = sigs.generate_nonce()
+        items = sorted(entries.items())
+        k = max(1, self.chunk_keys)
+        chunks = [dict(items[i:i + k]) for i in range(0, len(items), k)] or [{}]
+        targets = target.all_replicas()
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        acks: dict[str, M.ShardMigrateAck] = {}
+        self._ack_collects[session] = (fut, acks, target.quorum_size)
+        begin = M.ShardMigrateBegin(digests, session, len(chunks), support,
+                                    new_map.epoch)
+        for t in targets:
+            self.net.send(self.addr, t, begin)
+            for seq, chunk in enumerate(chunks):
+                self.net.send(self.addr, t,
+                              M.StateChunk(session, seq, chunk, kind="migrate"))
+        tracer.event("shard.migrate", source=source.gid, target=target.gid,
+                     keys=len(entries), chunks=len(chunks), seeder=seeder)
+        try:
+            await asyncio.wait_for(fut, self.ack_timeout)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            self._ack_collects.pop(session, None)
+
+        want = len(moving)
+        good = [a for a in acks.values() if a.accepted >= want]
+        if len(good) < target.quorum_size:
+            self._abort(
+                source, target, old_map,
+                f"migration ack quorum failed: {len(good)}/{len(targets)} "
+                f"replicas accepted all {want} verified keys "
+                f"(need >= {target.quorum_size})",
+            )
+
+        # cut-over: routers resolve the new map from the next attempt on
+        self.manager.activate(new_map)
+        metrics.set("dds_shard_epoch", new_map.epoch,
+                    help="active shard-map epoch")
+        if self.prune:
+            dropped = source.prune_unowned()
+            tracer.event("shard.pruned", source=source.gid, dropped=dropped)
+        log.info(
+            "reshard complete: %s -> %s, epoch %d, %d keys moved",
+            source.gid, target.gid, new_map.epoch, want,
+        )
+        return want
+
+    def _abort(self, source, target, old_map, reason: str) -> None:
+        # roll fencing back to the old map (force: epoch goes backwards);
+        # the router never saw the new map, so routing is untouched
+        source.state.install(old_map, force=True)
+        target.state.install(old_map, force=True)
+        metrics.inc("dds_reshard_aborts_total",
+                    help="live resharding attempts aborted safely")
+        tracer.event("shard.reshard_abort", source=source.gid,
+                     target=target.gid, reason=reason)
+        flight.record("reshard_abort", source=source.gid, target=target.gid,
+                      reason=reason, epoch=old_map.epoch)
+        log.warning("reshard %s -> %s aborted: %s", source.gid, target.gid,
+                    reason)
+        raise ReshardAborted(reason)

@@ -1,0 +1,231 @@
+"""Constellation shard maps: epoch-versioned, HMAC-signed keyspace partitions.
+
+The ROADMAP's first scale lever. A `ShardMap` deterministically partitions
+the key->set keyspace across S independent BFT-ABD quorum groups with a
+consistent-hash ring of virtual nodes: every group contributes
+`vnodes_per_group` ring positions derived from sha256(group_id # index),
+and a key belongs to the group owning the first vnode clockwise of
+sha256(key). Properties the rest of the plane leans on:
+
+- **deterministic**: any party holding the map resolves the same owner for
+  the same key — routers, replicas, and the rebalancer never negotiate.
+- **epoch-versioned**: maps only ever move forward; every client->replica
+  message carries the sender's epoch and replicas fence requests for keys
+  their group no longer owns (core/replica), so a stale map can stall a
+  request (retry under its Deadline budget) but never misroute it.
+- **HMAC-signed**: the map is operator state distributed to every fencing
+  party and served at GET /shards; the signature (intranet secret) stops a
+  credentialed-but-keyless peer from installing a forged map that silently
+  re-homes the keyspace.
+- **split-local**: `split()` places the new group's vnodes at the ring
+  midpoint of each victim vnode's arc, so a split moves (about half of)
+  the VICTIM's keys and nothing else — every other group's ownership is
+  bit-identical across the epoch bump, which is what keeps a live reshard
+  a single-group migration instead of a cluster-wide reshuffle.
+
+All groups share one Paillier modulus (the clients' key pair): sharding
+partitions *storage and quorum fan-out*, not the ciphertext algebra, so
+scatter-gathered aggregate partials combine with a plain modular-product
+tail reduction (parallel/mesh.combine_partials).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+from dataclasses import dataclass
+
+from dds_tpu.utils import sigs
+
+_RING = 1 << 64  # ring positions are the first 8 bytes of sha256
+
+
+def _position(label: str) -> int:
+    return int.from_bytes(hashlib.sha256(label.encode()).digest()[:8], "big")
+
+
+@dataclass(frozen=True)
+class ShardMap:
+    epoch: int
+    # sorted (ring position, group id) pairs; positions are unique
+    vnodes: tuple
+    groups: tuple
+    signature: bytes = b""
+
+    # ------------------------------------------------------------ building
+
+    @staticmethod
+    def build(groups: list[str], vnodes_per_group: int = 16,
+              epoch: int = 1) -> "ShardMap":
+        """Fresh map over `groups`; deterministic for a given group list."""
+        if not groups:
+            raise ValueError("a shard map needs at least one group")
+        vnodes = []
+        seen = set()
+        for gid in sorted(groups):
+            for i in range(vnodes_per_group):
+                pos = _position(f"{gid}#{i}")
+                while pos in seen:  # astronomically rare; keep positions unique
+                    pos = (pos + 1) % _RING
+                seen.add(pos)
+                vnodes.append((pos, gid))
+        vnodes.sort()
+        return ShardMap(epoch, tuple(vnodes), tuple(sorted(groups)))
+
+    def split(self, victim: str, new_gid: str) -> "ShardMap":
+        """Epoch+1 map where `new_gid` takes ~half of `victim`'s keyspace:
+        one new vnode at the ring midpoint of each victim vnode's arc.
+        Ownership outside the victim's arcs is untouched (unsigned —
+        callers sign the result before distributing it)."""
+        if victim not in self.groups:
+            raise ValueError(f"unknown victim group {victim!r}")
+        if new_gid in self.groups:
+            raise ValueError(f"group {new_gid!r} already in the map")
+        positions = [p for p, _ in self.vnodes]
+        added = []
+        taken = set(positions)
+        for i, (pos, gid) in enumerate(self.vnodes):
+            if gid != victim:
+                continue
+            pred = self.vnodes[i - 1][0]  # ring predecessor (wraps at i=0)
+            arc = (pos - pred) % _RING
+            if arc < 2:
+                continue
+            mid = (pred + arc // 2) % _RING
+            if mid in taken:
+                continue
+            taken.add(mid)
+            added.append((mid, new_gid))
+        if not added:
+            raise ValueError(f"victim {victim!r} has no splittable arc")
+        vnodes = tuple(sorted(self.vnodes + tuple(added)))
+        return ShardMap(self.epoch + 1, vnodes,
+                        tuple(sorted(self.groups + (new_gid,))))
+
+    # ------------------------------------------------------------- routing
+
+    @staticmethod
+    def key_position(key: str) -> int:
+        return _position(key)
+
+    def owner(self, key: str) -> str:
+        """Group owning `key`: first vnode clockwise of the key's position."""
+        positions = [p for p, _ in self.vnodes]
+        idx = bisect.bisect_left(positions, self.key_position(key))
+        return self.vnodes[idx % len(self.vnodes)][1]
+
+    # ---------------------------------------------------------- signatures
+
+    def _payload(self) -> dict:
+        return {"epoch": self.epoch,
+                "vnodes": [[p, g] for p, g in self.vnodes]}
+
+    def sign(self, secret: bytes) -> "ShardMap":
+        sig = sigs.manifest_signature(secret, "shard-map", self._payload(),
+                                      self.epoch)
+        return dataclasses.replace(self, signature=sig)
+
+    def verify(self, secret: bytes) -> bool:
+        return sigs.validate_manifest_signature(
+            secret, "shard-map", self._payload(), self.epoch, self.signature
+        )
+
+    # ---------------------------------------------------------------- wire
+
+    def to_wire(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "groups": list(self.groups),
+            "vnodes": [[p, g] for p, g in self.vnodes],
+            "signature": self.signature.hex(),
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "ShardMap":
+        return ShardMap(
+            int(d["epoch"]),
+            tuple((int(p), str(g)) for p, g in d["vnodes"]),
+            tuple(str(g) for g in d["groups"]),
+            bytes.fromhex(d.get("signature", "")),
+        )
+
+
+def moved_keys(old: ShardMap, new: ShardMap, keys) -> list[str]:
+    """Keys in `keys` whose owner changes between the two maps."""
+    return [k for k in keys if old.owner(k) != new.owner(k)]
+
+
+class ShardState:
+    """One replica group's live fencing state: the group id plus the
+    newest verified map the group has been handed. Every replica of a
+    group shares ONE instance (installed in a single step per group —
+    the in-process analogue of a config push), so `owns()` answers the
+    fence question consistently across the group."""
+
+    def __init__(self, group_id: str, smap: ShardMap, secret: bytes):
+        self.group_id = group_id
+        self.secret = secret
+        self._map = None
+        self.install(smap)
+
+    @property
+    def map(self) -> ShardMap:
+        return self._map
+
+    @property
+    def epoch(self) -> int:
+        return self._map.epoch
+
+    def owns(self, key: str) -> bool:
+        return self._map.owner(key) == self.group_id
+
+    def install(self, smap: ShardMap, force: bool = False) -> None:
+        """Adopt a newer signed map. `force` permits an epoch rollback —
+        reserved for the rebalancer's abort path, which restores the
+        previous map after a failed migration."""
+        if not smap.verify(self.secret):
+            raise ValueError("shard map signature invalid")
+        if self._map is not None and smap.epoch < self._map.epoch and not force:
+            raise ValueError(
+                f"shard map epoch moved backwards "
+                f"({self._map.epoch} -> {smap.epoch})"
+            )
+        self._map = smap
+
+
+class ShardManager:
+    """The routing authority: holds the ACTIVE map (what routers resolve
+    against) and the reshard state flag. During a live split the source
+    and target groups fence under the NEW map while the manager still
+    serves the old one; `activate()` is the final cut-over."""
+
+    def __init__(self, smap: ShardMap, secret: bytes):
+        if not smap.verify(secret):
+            raise ValueError("shard map signature invalid")
+        self.secret = secret
+        self._map = smap
+        self.state = "stable"  # stable | resharding
+
+    def current(self) -> ShardMap:
+        return self._map
+
+    @property
+    def epoch(self) -> int:
+        return self._map.epoch
+
+    def begin_reshard(self) -> None:
+        self.state = "resharding"
+
+    def end_reshard(self) -> None:
+        self.state = "stable"
+
+    def activate(self, smap: ShardMap) -> None:
+        if not smap.verify(self.secret):
+            raise ValueError("shard map signature invalid")
+        if smap.epoch <= self._map.epoch:
+            raise ValueError(
+                f"activation requires a newer epoch "
+                f"({smap.epoch} <= {self._map.epoch})"
+            )
+        self._map = smap

@@ -242,6 +242,33 @@ class ObsConfig:
 
 
 @dataclass
+class ShardConfig:
+    """Constellation sharding plane (dds_tpu/shard): partition the
+    keyspace across `count` independent BFT-ABD quorum groups, each with
+    its own replicas, spares, supervisor, anti-entropy loop, and attack
+    surface. Point ops route to one group; SumAll/MultAll scatter-gather
+    per-shard folds. Single-process (memory transport) topologies only —
+    the map-install step of a live reshard is an in-process config push;
+    multi-host map distribution is future work (DEPLOY.md "Sharding")."""
+
+    enabled: bool = False
+    count: int = 2
+    # consistent-hash ring positions contributed per group; more vnodes =
+    # smoother key balance, marginally slower owner lookups
+    vnodes_per_group: int = 16
+    # per-group geometry (groups are homogeneous; n = active + spares)
+    replicas_per_group: int = 4
+    sentinent_per_group: int = 1
+    quorum_size: int = 3               # 2f+1 at f=1 for the default 4
+    max_faults: int = 1
+    # live resharding (shard/rebalance): migration stream chunking and
+    # the attestation/ack collection timeouts
+    migrate_chunk_keys: int = 256
+    manifest_timeout: float = 2.0
+    ack_timeout: float = 5.0
+
+
+@dataclass
 class AttackConfig:
     enabled: bool = False
     # crash | byzantine | partition | delay | flood | heal (the network
@@ -264,6 +291,7 @@ class DDSConfig:
     client: ClientSettings = field(default_factory=ClientSettings)
     attacks: AttackConfig = field(default_factory=AttackConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    shard: ShardConfig = field(default_factory=ShardConfig)
     debug: bool = False
 
     # ------------------------------------------------------------- loading
@@ -308,5 +336,6 @@ _SUBSECTIONS = {
     ("DDSConfig", "client"): ClientSettings,
     ("DDSConfig", "attacks"): AttackConfig,
     ("DDSConfig", "obs"): ObsConfig,
+    ("DDSConfig", "shard"): ShardConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
 }

@@ -49,6 +49,10 @@ class Cluster:
                 proactive_recovery_warmup=0.05,
                 proactive_recovery_interval=0.1,
                 sentinent_awake_timeout=0.5,
+                # bounded so a dead-host seed path (and the graceful
+                # stop() that now awaits it) cannot pin a test for the
+                # 12 s production default
+                crashed_recovery_timeout=2.0,
             ),
             redeploy=self._redeploy,
             rng=random.Random(3),

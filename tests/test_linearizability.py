@@ -307,7 +307,15 @@ def test_chaos_quorum_breaking_partition_stalls_then_heals():
 def test_chaos_delay_storm_during_proactive_recovery():
     """Schedule 3: jittered delays on EVERY link while the proactive
     recovery timer swaps replicas mid-workload. Linearizability holds,
-    and after heal the supervisor converges back to full membership."""
+    and after heal the supervisor converges back to full membership.
+
+    Event-driven (deflaked): the membership assertion waits on the
+    supervisor's recovery-complete hook instead of racing stop() against
+    an in-flight swap — cancelling recover() mid-swap left a spare
+    promoted with the offender not yet demoted (8 active / 1 sentinent),
+    the pre-existing 8/10 isolation failure. stop() itself is now
+    graceful (awaits the shielded in-flight recovery), and the explicit
+    wait asserts the hook resolves within the recovery timeouts."""
 
     async def go():
         c, net = chaos_cluster(seed=303, proactive=True)
@@ -319,6 +327,9 @@ def test_chaos_delay_storm_during_proactive_recovery():
             _chaos_reader(c, rec, 10, seed=7),
         )
         net.heal_all()
+        assert await c.supervisor.wait_recovery_idle(10.0), (
+            "recovery never quiesced after heal"
+        )
         await c.supervisor.stop()
         await net.quiesce()
         check_atomic_register(rec.ops)
