@@ -1,0 +1,117 @@
+"""Prism PC-MM benchmark: server-side Enc(W·x) vs client decrypt-and-compute.
+
+The structural claim behind the analytics plane (arxiv 2504.14497): a
+plaintext-matrix x ciphertext-vector product is structured batches of
+modexp/modmul, so evaluating it SERVER-SIDE over ciphertexts (one
+`backend.matvec` — the weighted-fold kernel or its host twin) competes
+with the only alternative the 2017 query set offers: download every
+ciphertext, decrypt all K of them client-side, and compute W @ x in
+plaintext. The client baseline here is deliberately generous — it pays
+only the K CRT decrypts plus the plaintext matmul, with zero network or
+re-encryption cost — so `vs_baseline` (client seconds / server seconds)
+understates the deployed advantage.
+
+Every trial is decrypt-verified against the plaintext W @ x before it is
+timed into a record — a benchmark that silently computes garbage is worse
+than a slow one. Weights default to unsigned `--weight-bits`-wide values;
+`--signed` mixes in negative weights, which the n-|w| encoding makes
+full-n-width exponents — a different (and much heavier) server cost
+class, kept out of the default sweep so the records stay comparable.
+
+Emits one `analytics matvec` record per shape via common.emit();
+benchmarks/sentry.py --check validates these records in results*.json
+(exit 2 on malformed, same contract as the shard-scaling rows).
+
+Usage: python -m benchmarks.analytics_matvec [--shapes 4x64,16x256]
+       [--bits 512] [--weight-bits 16] [--backend cpu] [--repeats 3]
+       [--signed]
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+
+from benchmarks.common import best_of, emit
+
+
+def _parse_shapes(spec: str) -> list[tuple[int, int]]:
+    shapes = []
+    for part in spec.split(","):
+        r, _, k = part.strip().partition("x")
+        shapes.append((int(r), int(k)))
+        if shapes[-1][0] < 1 or shapes[-1][1] < 1:
+            raise SystemExit(f"bad shape {part!r} (need RxK, both >= 1)")
+    return shapes
+
+
+def main(argv=None) -> list:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--shapes", default="4x64,16x256",
+                    help="comma-separated RxK weight-matrix shapes")
+    ap.add_argument("--bits", type=int, default=512,
+                    help="Paillier modulus bits (local-prime keygen "
+                         "below 1024, so no `cryptography` needed)")
+    ap.add_argument("--weight-bits", type=int, default=16,
+                    help="weight magnitude in bits")
+    ap.add_argument("--backend", default="cpu",
+                    help="server-side CryptoBackend (cpu | tpu | native)")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--signed", action="store_true",
+                    help="mix in negative weights (full-width exponents "
+                         "via the n-|w| encoding — a heavier cost class)")
+    ap.add_argument("--seed", type=int, default=23)
+    args = ap.parse_args(argv)
+
+    from dds_tpu.models.backend import get_backend
+    from dds_tpu.models.paillier import PaillierKey
+
+    rng = random.Random(args.seed)
+    key = PaillierKey.generate(args.bits)
+    pk = key.public
+    be = get_backend(args.backend)
+    wb = args.weight_bits
+
+    rows = []
+    for R, K in _parse_shapes(args.shapes):
+        xs = [rng.randrange(1 << 24) for _ in range(K)]
+        cs = [pk.encrypt_fast(x) for x in xs]
+        lo = -(1 << wb) + 1 if args.signed else 0
+        W = [[rng.randrange(lo, 1 << wb) for _ in range(K)] for _ in range(R)]
+        enc = pk.matvec_encode(W)
+
+        out = be.matvec(cs, enc, pk.nsquare)  # warm (+ the verified copy)
+        got = [key.to_signed(key.decrypt(c)) for c in out]
+        want = [sum(w * x for w, x in zip(row, xs)) for row in W]
+        if got != want:
+            raise SystemExit(
+                f"analytics matvec MISCOMPUTED at {R}x{K}: refusing to "
+                f"record a timing for a wrong result"
+            )
+        server_s = best_of(lambda: be.matvec(cs, enc, pk.nsquare),
+                           args.repeats)
+
+        def client_side():
+            # the pre-Prism path: decrypt everything, matmul in plaintext
+            ms = [key.to_signed(m) for m in key.decrypt_batch(cs)]
+            return [sum(w * x for w, x in zip(row, ms)) for row in W]
+
+        assert client_side() == want
+        client_s = best_of(client_side, args.repeats)
+
+        sign = "signed" if args.signed else "unsigned"
+        rows.append(emit(
+            f"analytics matvec: Enc(W·x) rows/s @ {R}x{K}, "
+            f"{args.bits}-bit, {sign} w{wb}",
+            R / server_s, "rows/s",
+            vs_baseline=client_s / server_s,
+            rows=R, cols=K, paillier_bits=args.bits, weight_bits=wb,
+            signed=args.signed, backend=be.name,
+            server_ms=round(server_s * 1e3, 3),
+            client_ms=round(client_s * 1e3, 3),
+        ))
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -18,9 +18,9 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args(argv)
 
-    from benchmarks import (audit_cost, bft_sum, crossover, encrypt_modexp,
-                            mixed, product, put_concurrency, shard_scaling,
-                            sweep)
+    from benchmarks import (analytics_matvec, audit_cost, bft_sum, crossover,
+                            encrypt_modexp, mixed, product, put_concurrency,
+                            shard_scaling, sweep)
 
     rows = []
     if args.quick:
@@ -31,6 +31,9 @@ def main(argv=None):
         rows += put_concurrency.main(["--ops", "32", "--clients", "1", "4"])
         rows += audit_cost.main(["--k", "256", "--requests", "5"])
         rows += shard_scaling.main(["--ops", "120", "--shards", "1,2"])
+        rows += analytics_matvec.main(
+            ["--shapes", "2x8", "--bits", "256", "--repeats", "1"]
+        )
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -41,6 +44,7 @@ def main(argv=None):
         rows += crossover.main([])
         rows += encrypt_modexp.main([])
         rows += shard_scaling.main([])
+        rows += analytics_matvec.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

@@ -6,9 +6,10 @@ Modes (all emit one JSON line to stdout):
         Parse + validate the stored baseline file only (no kernels run;
         no jax import) — the CPU-only smoke CI runs so a corrupted
         baseline is caught before it silently disables gating.
-        Also parses any `shard scaling` records in benchmarks/results.json
-        / results_quick.json (benchmarks/shard_scaling.py output) so a
-        malformed scaling record is caught by the same smoke.
+        Also parses any `shard scaling` (benchmarks/shard_scaling.py) and
+        `analytics matvec` (benchmarks/analytics_matvec.py) records in
+        benchmarks/results.json / results_quick.json so a malformed
+        scaling or analytics record is caught by the same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -67,15 +68,12 @@ def probe(repeats: int = 5) -> dict:
     return sentry.collect()
 
 
-def _check_shard_records() -> dict:
-    """Validate `shard scaling` rows (benchmarks/shard_scaling.py) in the
-    suite result files: each must carry a positive ops/s value and a
-    detail block naming its shard count and per-shard key split. Returns
-    {"rows": n} or raises ValueError on a malformed record — the same
-    contract load_baseline has, mapped to exit 2 by --check."""
-    found = 0
+def _iter_result_rows(root: str):
+    """(file name, record) for every row in the suite result files.
+    Unreadable/mis-shaped files raise ValueError — the shared malformed
+    contract the per-family checkers map to exit 2."""
     for name in ("results.json", "results_quick.json"):
-        path = os.path.join(REPO, "benchmarks", name)
+        path = os.path.join(root, "benchmarks", name)
         if not os.path.exists(path):
             continue
         with open(path) as f:
@@ -86,23 +84,64 @@ def _check_shard_records() -> dict:
         if not isinstance(rows, list):
             raise ValueError(f"malformed results file {name}: expected a list")
         for row in rows:
-            if not (isinstance(row, dict)
-                    and str(row.get("metric", "")).startswith("shard scaling")):
-                continue
-            detail = row.get("detail")
-            ok = (
-                isinstance(row.get("value"), (int, float)) and row["value"] > 0
-                and isinstance(detail, dict)
-                and isinstance(detail.get("shards"), int)
-                and detail["shards"] >= 1
-                and isinstance(detail.get("per_shard_keys"), dict)
+            yield name, row
+
+
+def _check_shard_records(root: str = REPO) -> dict:
+    """Validate `shard scaling` rows (benchmarks/shard_scaling.py) in the
+    suite result files: each must carry a positive ops/s value and a
+    detail block naming its shard count and per-shard key split. Returns
+    {"rows": n} or raises ValueError on a malformed record — the same
+    contract load_baseline has, mapped to exit 2 by --check."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("shard scaling")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("shards"), int)
+            and detail["shards"] >= 1
+            and isinstance(detail.get("per_shard_keys"), dict)
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed shard-scaling record in {name}: "
+                f"{row.get('metric')!r}"
             )
-            if not ok:
-                raise ValueError(
-                    f"malformed shard-scaling record in {name}: "
-                    f"{row.get('metric')!r}"
-                )
-            found += 1
+        found += 1
+    return {"rows": found}
+
+
+def _check_analytics_records(root: str = REPO) -> dict:
+    """Validate `analytics matvec` rows (benchmarks/analytics_matvec.py):
+    positive rows/s value, a detail block naming the matrix shape, and
+    positive server/client timings (the comparison the record exists
+    for). Same malformed contract as the shard-scaling rows: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("analytics matvec")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("rows"), int) and detail["rows"] >= 1
+            and isinstance(detail.get("cols"), int) and detail["cols"] >= 1
+            and isinstance(detail.get("server_ms"), (int, float))
+            and detail["server_ms"] > 0
+            and isinstance(detail.get("client_ms"), (int, float))
+            and detail["client_ms"] > 0
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed analytics record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
     return {"rows": found}
 
 
@@ -146,6 +185,7 @@ def main(argv=None) -> int:
     if args.check:
         try:
             shard = _check_shard_records()
+            analytics = _check_analytics_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -154,6 +194,7 @@ def main(argv=None) -> int:
             "ok": True, "mode": "check", "baseline": path,
             "kernels": len(baseline), "exists": bool(baseline),
             "shard_scaling_rows": shard["rows"],
+            "analytics_rows": analytics["rows"],
         }))
         return 0
 

@@ -1,0 +1,5 @@
+"""Prism: the encrypted analytics plane (plaintext-matrix x
+ciphertext-vector products over Paillier, served as sharded REST routes).
+See prism.py for the engine and DEPLOY.md "Encrypted analytics"."""
+
+from dds_tpu.analytics.prism import Prism  # noqa: F401

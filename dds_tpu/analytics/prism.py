@@ -1,0 +1,195 @@
+"""Prism: server-side encrypted analytics over the stored ciphertexts.
+
+The store's aggregate routes fold ONE position across all records
+(`SumAll`/`MultAll`); Prism generalizes that to plaintext-matrix x
+Paillier-ciphertext-vector products (PC-MM, arxiv 2504.14497):
+
+    Enc(W @ x)[r] = prod_j Enc(x_j) ** W[r][j]   mod n^2
+
+evaluated entirely proxy-side from PUBLIC parameters — ciphertexts, the
+client's plaintext weight matrix, and n^2 from the request, never keys —
+the same trust boundary every other ciphertext-compute route has (and
+deliberately NOT the secret-parameter path ADVICE.md flags on the decrypt
+side: no CRT modulus ever enters this plane, so ModCtx's global cache and
+the persistent compile cache are safe here). Negative weights ride the
+n - |w| exponent encoding (`models/paillier.matvec_encode`).
+
+This unlocks the workload class the 2017 reference never had: encrypted
+scoring (`MatVec`), weighted aggregates (`WeightedSum` = one row), and
+group-by rollups (`GroupBySum` = 0/1 selector rows), all without the
+client downloading and decrypting the store.
+
+Sharding: operand columns partition by owning shard group exactly like
+`_fold_aggregate`'s scatter-gather, one batched weighted fold dispatches
+per group CONCURRENTLY, and per-row partials merge with the mesh plane's
+modular-product tail combine (`parallel/mesh.combine_partials`). Every
+group shares one Paillier modulus and the row product is associative and
+commutative over any column partition, so the sharded result is
+bit-for-bit the unsharded one.
+
+Request validation failures raise ValueError (mapped to 400 at the REST
+edge); the row cap (`ops/flags.analytics_max_rows`) bounds how much
+kernel work one request can demand.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from dds_tpu.models.paillier import PaillierPublicKey
+from dds_tpu.obs.metrics import SIZE_BUCKETS, metrics
+from dds_tpu.utils.trace import tracer
+
+
+@dataclass
+class Prism:
+    """The analytics engine one REST proxy owns: a ciphertext backend, the
+    per-request row cap, and (when sharded) the key -> group-id resolver
+    the scatter partition uses (None = unsharded, single dispatch)."""
+
+    backend: object
+    max_rows: int = 256
+    owner: Optional[Callable[[str], str]] = None
+
+    # ------------------------------------------------------------ validation
+
+    @staticmethod
+    def parse_nsqr(nsqr: str) -> tuple[int, int]:
+        """(n, n^2) from the route's decimal `nsqr` query param. The weight
+        encoding needs n itself, which must exist: a non-square `nsqr`
+        cannot be a Paillier modulus and is rejected as a bad request."""
+        try:
+            n2 = int(nsqr)
+        except ValueError:
+            raise ValueError("nsqr must be a decimal integer") from None
+        n = math.isqrt(n2) if n2 > 0 else 0
+        if n < 3 or n * n != n2:
+            raise ValueError("nsqr must be a perfect square (Paillier n^2)")
+        return n, n2
+
+    def encode_weights(
+        self, rows: list[list[int]], n: int, cols: int
+    ) -> list[list[int]]:
+        """Shape-check a signed weight matrix against the operand count and
+        encode it to exponent residues (negatives -> n - |w|)."""
+        if not rows:
+            raise ValueError("weights must have at least one row")
+        if len(rows) > self.max_rows:
+            raise ValueError(
+                f"{len(rows)} weight rows exceed the analytics row cap "
+                f"{self.max_rows} (DDS_ANALYTICS_MAX_ROWS / [analytics] "
+                f"max-rows)"
+            )
+        for row in rows:
+            if len(row) != cols:
+                raise ValueError(
+                    f"weight rows must span the {cols} stored operand "
+                    f"column(s) at this position, got {len(row)}"
+                )
+        return PaillierPublicKey(n).matvec_encode(rows)
+
+    def selector_rows(
+        self, groups: dict[str, list[str]], keys: list[str]
+    ) -> tuple[list[str], list[list[int]]]:
+        """GroupBySum's 0/1 weight matrix: one selector row per group
+        label (sorted, for a deterministic response), 1 where the operand
+        column's record key is in the group. A group naming a key that is
+        not an operand column is a bad request — silently dropping it
+        would return a rollup over a different set than asked for."""
+        if not groups:
+            raise ValueError("groups must name at least one group")
+        if len(groups) > self.max_rows:
+            raise ValueError(
+                f"{len(groups)} groups exceed the analytics row cap "
+                f"{self.max_rows}"
+            )
+        index = {k: i for i, k in enumerate(keys)}
+        labels = sorted(groups)
+        rows = []
+        for label in labels:
+            row = [0] * len(keys)
+            for k in groups[label]:
+                i = index.get(k)
+                if i is None:
+                    raise ValueError(
+                        f"group {label!r} names unknown record key {k!r}"
+                    )
+                row[i] = 1
+            rows.append(row)
+        return labels, rows
+
+    # ------------------------------------------------------------ evaluation
+
+    def _partition(self, keys: list[str]) -> list[list[int]] | None:
+        """Column indices grouped by owning shard, or None when the whole
+        request is a single dispatch (unsharded, or one group owns all)."""
+        if self.owner is None:
+            return None
+        groups: dict[str, list[int]] = {}
+        for i, k in enumerate(keys):
+            groups.setdefault(self.owner(k), []).append(i)
+        parts = list(groups.values())
+        return parts if len(parts) > 1 else None
+
+    async def evaluate(
+        self,
+        route: str,
+        keys: list[str],
+        ciphers: list[int],
+        encoded: list[list[int]],
+        n2: int,
+    ) -> list[int]:
+        """Dispatch one request's encoded weighted fold: scatter per shard
+        when the columns span groups, gather with combine_partials."""
+        R, K = len(encoded), len(ciphers)
+        metrics.inc(
+            "dds_analytics_requests_total", route=route,
+            help="Prism encrypted-analytics requests by route",
+        )
+        metrics.observe(
+            "dds_analytics_rows", R, buckets=SIZE_BUCKETS,
+            help="weight rows per analytics request",
+        )
+        metrics.observe(
+            "dds_analytics_cols", K, buckets=SIZE_BUCKETS,
+            help="ciphertext operand columns per analytics request",
+        )
+        parts = self._partition(keys)
+        t0 = time.perf_counter()
+        backend_name = getattr(self.backend, "name", "?")
+        with tracer.span(
+            "analytics.matvec", rows=R, cols=K,
+            shards=len(parts) if parts else 1, backend=backend_name,
+        ):
+            if parts is not None:
+                # one weighted fold per owning group, dispatched
+                # concurrently (each on a worker thread so device/host
+                # folds overlap), merged per row with the same tail
+                # combine the SumAll scatter path uses
+                from dds_tpu.parallel.mesh import combine_partials
+
+                async def one(idxs: list[int]) -> list[int]:
+                    sub_cs = [ciphers[i] for i in idxs]
+                    sub_w = [[row[i] for i in idxs] for row in encoded]
+                    return await asyncio.to_thread(
+                        self.backend.matvec, sub_cs, sub_w, n2
+                    )
+
+                partials = await asyncio.gather(*(one(ix) for ix in parts))
+                out = [
+                    combine_partials([p[r] for p in partials], n2)
+                    for r in range(R)
+                ]
+            else:
+                out = await asyncio.to_thread(
+                    self.backend.matvec, ciphers, encoded, n2
+                )
+        metrics.observe(
+            "dds_analytics_matvec_seconds", time.perf_counter() - t0,
+            help="analytics weighted-fold evaluation latency",
+        )
+        return out

@@ -1,8 +1,9 @@
 """REST proxy: the 23-route encrypted query engine.
 
 Counterpart of `dds/http/DDSRestServer.scala:153-948` — same 23 route
-names, parameters, JSON shapes and status codes (plus one addition of
-ours: GET /_trace, the live tracing summary) — rebuilt around two
+names, parameters, JSON shapes and status codes (plus additions of ours:
+GET /_trace and the Prism encrypted-analytics family POST /MatVec,
+/WeightedSum, /GroupBySum — see dds_tpu/analytics) — rebuilt around two
 TPU-first ideas the reference lacks:
 
 - all ciphertext arithmetic goes through the pluggable `CryptoBackend`
@@ -165,6 +166,15 @@ class ProxyConfig:
     # is: it is the health surface operators page on, and it reveals no
     # more workload shape than the per-route metric series already do.
     slo_route_enabled: bool = True
+    # Prism encrypted-analytics routes (analytics/prism.py): POST /MatVec,
+    # /WeightedSum, /GroupBySum evaluate plaintext-weight x ciphertext
+    # products server-side over public parameters only. The row cap bounds
+    # per-request kernel work (DDS_ANALYTICS_MAX_ROWS env overrides it;
+    # ops/flags.analytics_max_rows validates whichever wins); the byte cap
+    # 413s oversized weight payloads before JSON parsing.
+    analytics_enabled: bool = True
+    analytics_max_rows: int = 256
+    analytics_max_request_bytes: int = 1 << 20
     # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
     replica_refresh_interval: float = 5.0
     supervisor: Optional[str] = None
@@ -231,6 +241,21 @@ class DDSRestServer:
         # path exactly as before
         self._shards = getattr(abd, "shard_manager", None)
         self._scatter_memo: tuple | None = None  # pairs identity -> shard operands
+        # Prism analytics engine (analytics/prism): same backend, same
+        # public-parameter boundary; sharded proxies hand it the router's
+        # owner resolver so weighted folds scatter-gather like SumAll
+        if self.cfg.analytics_enabled:
+            from dds_tpu.analytics import Prism
+            from dds_tpu.ops.flags import analytics_max_rows
+
+            self.prism: Prism | None = Prism(
+                backend=self.backend,
+                max_rows=analytics_max_rows(self.cfg.analytics_max_rows),
+                owner=(self.abd.owner if self._shards is not None else None),
+            )
+        else:
+            self.prism = None
+        self._column_memo: tuple | None = None  # pairs identity -> columns
 
     # ------------------------------------------------------------ lifecycle
 
@@ -897,6 +922,14 @@ class DDSRestServer:
                 ]
                 return Response.json(J.keys_result(keyset))
 
+            # ---------------- Prism encrypted analytics (PC-MM) ----------------
+
+            case ("POST", "MatVec") | ("POST", "WeightedSum") | (
+                "POST",
+                "GroupBySum",
+            ) if self.prism is not None:
+                return await self._analytics(name, req)
+
             case ("POST", "_sync"):
                 for k in J.parse_keys(req.json()):
                     self._note_stored(k)
@@ -1195,6 +1228,60 @@ class DDSRestServer:
             for o in operands:
                 result *= o
         return Response.json(J.value_result(str(result)))
+
+    # ------------------------------------------------- Prism analytics routes
+
+    def _columns(self, pairs, pos: int) -> tuple[list[str], list[int]]:
+        """(keys, ciphertexts) of every stored record holding position
+        `pos`, in sorted-key order — the operand column order the analytics
+        routes expose (and echo back as `keys` so clients can line their
+        weight matrices up). Memoized per pairs-identity like the flat
+        operand memo."""
+        memo = self._column_memo
+        if memo is not None and memo[0] is pairs and memo[1] == pos:
+            return memo[2], memo[3]
+        keys = [k for k, v in pairs if pos < len(v)]
+        ciphers = [int(v[pos]) for _, v in pairs if pos < len(v)]
+        self._column_memo = (pairs, pos, keys, ciphers)
+        return keys, ciphers
+
+    async def _analytics(self, name: str, req: Request) -> Response:
+        """`MatVec` / `WeightedSum` / `GroupBySum`: server-side
+        Enc(W @ x) over the stored records' position-`pos` ciphertexts
+        (analytics/prism.py). Validation failures raise ValueError ->
+        400 via handle(); the body-size cap answers 413 before JSON
+        parsing so an oversized weight blob never costs a parse."""
+        cap = self.cfg.analytics_max_request_bytes
+        if cap > 0 and len(req.body) > cap:
+            return Response(
+                413,
+                f"analytics request body exceeds {cap} bytes".encode(),
+            )
+        pos = self._pos(req)
+        n, n2 = self.prism.parse_nsqr(req.query["nsqr"])
+        pairs = await self._fetch_stored()
+        keys, ciphers = self._columns(pairs, pos)
+        if not ciphers:
+            return Response(404)
+        body = req.json()
+        labels = None
+        if name == "MatVec":
+            rows = J.parse_weight_matrix(body)
+        elif name == "WeightedSum":
+            rows = [J.parse_weight_row(body)]
+        else:  # GroupBySum: 0/1 selector rollups over record keys
+            labels, rows = self.prism.selector_rows(J.parse_groups(body), keys)
+        encoded = self.prism.encode_weights(rows, n, cols=len(ciphers))
+        out = await self.prism.evaluate(name, keys, ciphers, encoded, n2)
+        if name == "WeightedSum":
+            return Response.json({"result": str(out[0]), "keys": keys})
+        if labels is not None:
+            return Response.json(
+                {"result": {lb: str(c) for lb, c in zip(labels, out)}}
+            )
+        return Response.json(
+            {"result": [str(c) for c in out], "keys": keys}
+        )
 
     def _shard_operands(self, pairs, pos: int) -> list[list[int]]:
         """Aggregate operands partitioned by owning shard group (memoized

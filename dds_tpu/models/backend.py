@@ -33,6 +33,26 @@ class CryptoBackend(Protocol):
 
     def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]: ...
 
+    def matvec(
+        self, cs: list[int], weights: list[list[int]], modulus: int
+    ) -> list[int]: ...
+
+
+def _host_matvec(
+    cs: list[int], weights: list[list[int]], modulus: int, powmod=pow
+) -> list[int]:
+    """Per-row weighted fold on host ints: out[r] = prod_j cs[j]^w[r][j]
+    mod modulus, skipping zero weights (the common case for GroupBySum
+    selector rows). Shared by every backend's below-crossover path."""
+    out = []
+    for row in weights:
+        acc = 1
+        for c, w in zip(cs, row):
+            if w:
+                acc = acc * powmod(c, w, modulus) % modulus
+        out.append(acc)
+    return out
+
 
 class CpuBackend:
     """Python-int reference backend (the CPU baseline of BASELINE.md)."""
@@ -50,6 +70,11 @@ class CpuBackend:
 
     def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
         return [pow(b, exp, modulus) for b in bases]
+
+    def matvec(
+        self, cs: list[int], weights: list[list[int]], modulus: int
+    ) -> list[int]:
+        return _host_matvec(cs, weights, modulus)
 
 
 def _use_pallas() -> bool:
@@ -213,6 +238,24 @@ class TpuBackend:
 
         return foldmany.fold_many(folds, modulus, kernel=self._mesh_kernel())
 
+    def matvec(
+        self, cs: list[int], weights: list[list[int]], modulus: int
+    ) -> list[int]:
+        """Plaintext-matrix x ciphertext-vector products (Prism / PC-MM):
+        one batched weighted-fold dispatch (ops/foldmany.fold_weighted)
+        when the R*K cell count clears the device crossover; below it the
+        host loop wins for the same dispatch-latency reason small
+        aggregates do."""
+        if len(weights) * len(cs) < self.min_device_batch:
+            from dds_tpu.native import powmod
+
+            return _host_matvec(cs, weights, modulus, powmod=powmod)
+        from dds_tpu.ops import foldmany
+
+        return foldmany.fold_weighted(
+            cs, weights, modulus, kernel=self._mesh_kernel()
+        )
+
     def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
         ctx = ModCtx.make(modulus)
         batch = bn.ints_to_batch(bases, ctx.L)
@@ -270,6 +313,13 @@ class NativeBackend:
         from dds_tpu import native
 
         return native.powmod_batch(bases, exp, modulus)
+
+    def matvec(
+        self, cs: list[int], weights: list[list[int]], modulus: int
+    ) -> list[int]:
+        from dds_tpu.native import powmod
+
+        return _host_matvec(cs, weights, modulus, powmod=powmod)
 
 
 _BACKENDS = {"cpu": CpuBackend, "tpu": TpuBackend, "native": NativeBackend}

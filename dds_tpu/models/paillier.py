@@ -155,6 +155,52 @@ class PaillierPublicKey:
     def scalar_mul(self, c: int, k: int) -> int:
         return powmod(c, k, self.nsquare)
 
+    def matvec_encode(self, weights) -> list[list[int]]:
+        """Encode a SIGNED plaintext weight matrix into Paillier exponent
+        residues for ciphertext-side evaluation (the Prism analytics
+        plane): Enc(x)^w = Enc(w*x mod n), and a negative weight encodes
+        as n - |w| — an exponent congruent to -|w| mod n, so the signed
+        decode (`PaillierKey.to_signed`) recovers the negative
+        contribution. This is THE encoding site: the REST plane, the
+        weighted-fold kernel, and the benchmarks all route through it.
+
+        Rejects |w| >= n (not representable as a distinct residue).
+        Decodability of the RESULT is the caller's contract, as for every
+        Paillier sum: each row's plaintext W_r . x must stay in
+        (-n/2, n/2] or the signed mapping wraps. Note a negative weight's
+        encoded exponent is full n-width — a ciphertext-side scalar mult
+        by -3 costs a ~n-bit modexp, not a 2-bit one (DEPLOY.md "Encrypted
+        analytics")."""
+        n = self.n
+        out = []
+        for row in weights:
+            enc = []
+            for w in row:
+                w = int(w)
+                if not -n < w < n:
+                    raise ValueError(
+                        f"weight magnitude {abs(w).bit_length()} bits "
+                        f"exceeds the {n.bit_length()}-bit modulus"
+                    )
+                enc.append(w % n)
+            out.append(enc)
+        return out
+
+    def matvec(self, cs: list[int], weights: list[list[int]]) -> list[int]:
+        """Host reference for Enc(W @ x): per encoded weight row r
+        (`matvec_encode` output), prod_j cs[j]^W[r][j] mod n^2 — one
+        modexp per nonzero weight. The batched kernel twin is
+        ops/foldmany.fold_weighted; backends pick between them."""
+        n2 = self.nsquare
+        out = []
+        for row in weights:
+            acc = 1
+            for c, w in zip(cs, row, strict=True):
+                if w:
+                    acc = acc * powmod(c, w, n2) % n2
+            out.append(acc)
+        return out
+
 
 @dataclass(frozen=True)
 class PaillierKey:

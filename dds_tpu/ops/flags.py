@@ -26,6 +26,32 @@ def karatsuba_mode() -> str | bool:
     )
 
 
+def analytics_max_rows(default: int = 256) -> int:
+    """Per-request weight-row cap for the Prism analytics routes (MatVec
+    rows / GroupBySum groups): DDS_ANALYTICS_MAX_ROWS when set, else
+    `default` (the `[analytics] max-rows` config value flows in here).
+    Whatever wins is validated the same loud way DDS_PROD_TB is — int,
+    within [1, 65536] — so a typo fails at server construction with an
+    actionable message instead of surfacing as a per-request 500. The
+    ceiling bounds the weight-matrix kernel work one request can demand:
+    rows x columns x exponent-width modmuls all scale with it."""
+    env = os.environ.get("DDS_ANALYTICS_MAX_ROWS", "").strip()
+    source = "DDS_ANALYTICS_MAX_ROWS" if env else "[analytics] max-rows"
+    raw = env if env else default
+    try:
+        rows = int(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{source} must be an integer row count, got {raw!r}"
+        ) from None
+    if not 1 <= rows <= 65536:
+        raise ValueError(
+            f"{source} must be in [1, 65536] (per-request analytics row "
+            f"cap), got {rows}"
+        )
+    return rows
+
+
 def prod_tb() -> int | None:
     """DDS_PROD_TB: lane-tile override for the MXU product kernel, or None
     when unset. Validated HERE — int, positive, multiple of the 128-lane

@@ -18,6 +18,12 @@ the coalescer groups by modulus.
 
 Compiled executables retrace per (P2, R); both axes are bucketed to
 powers of two by the caller so the shape set stays tiny.
+
+`fold_weighted` extends the same machinery to weighted folds — per-row
+products of operands raised to per-(row, operand) plaintext exponents —
+the plaintext-ciphertext matrix-multiplication kernel of the Prism
+analytics plane (dds_tpu/analytics). It shares the compiled-fn cache,
+kernel-family selection, and Montgomery contexts with fold_many.
 """
 
 from __future__ import annotations
@@ -91,6 +97,132 @@ def _fold_many_fn(ctx: ModCtx, kernel: str, R: int):
             _FN_CACHE.pop(next(iter(_FN_CACHE)), None)
         _FN_CACHE[key] = fn
     return fn
+
+
+_WINDOW = 4  # digit width of the weighted fold's ladder (16-entry tables)
+
+
+def _fold_weighted_fn(ctx: ModCtx, kernel: str):
+    """Compiled weighted-fold kernel for (ctx, kernel family): shapes are
+    NOT in the cache key — jit retraces per (P2, Rp, D) input shape under
+    one entry, like mesh's "reduce" keys — but the karatsuba/interpret
+    flags are, for the same stale-executable reason as _fold_many_fn."""
+    interpret = _interpret_default()
+    kmode = karatsuba_mode() if kernel == "v2" else None
+    key = ("weighted", ctx.n, kernel, interpret, kmode)
+    fn = _FN_CACHE.get(key)
+    kprof.cache_event("fold_weighted", hit=fn is not None)
+    if fn is not None:
+        return fn
+    mul = _mul_bm(ctx, kernel, interpret)
+    one_mont = jnp.asarray(ctx.one_mont)
+    R2 = jnp.asarray(ctx.R2)
+    one_plain = np.zeros((ctx.L,), np.uint32)
+    one_plain[0] = 1
+    one_plain = jnp.asarray(one_plain)
+    L = ctx.L
+
+    def run(cs, digits):
+        # cs: (P2, L) plain-domain operands; digits: (D, Rp, P2) int32
+        # MSB-first 4-bit windows of each (row, operand) weight. Everything
+        # runs in the Montgomery domain (entry via R2, exit via 1), so no
+        # R-power bookkeeping is needed: mont_mul is closed over x~ = xR.
+        P2 = cs.shape[0]
+        Rp = digits.shape[1]
+        cs_m = mul(cs, jnp.broadcast_to(R2, cs.shape))
+        # table[d, k] = cs[k]^d for d in [0, 16): row-independent, so the
+        # per-digit gather below serves every output row from one table
+        tab = [jnp.broadcast_to(one_mont, cs.shape), cs_m]
+        for _ in range(2, 1 << _WINDOW):
+            tab.append(mul(tab[-1], cs_m))
+        table = jnp.stack(tab, axis=0)             # (16, P2, L)
+        kidx = jnp.arange(P2)[None, :]
+
+        def step(acc, dig):                        # acc (Rp, L); dig (Rp, P2)
+            for _ in range(_WINDOW):
+                acc = mul(acc, acc)
+            sel = table[dig, kidx]                 # (Rp, P2, L)
+            w = P2
+            x = sel
+            while w > 1:                           # tree fold over operands
+                h = w // 2
+                x = mul(
+                    x[:, :h].reshape(-1, L), x[:, h : 2 * h].reshape(-1, L)
+                ).reshape(Rp, h, L)
+                w = h
+            return mul(acc, x[:, 0]), None
+
+        acc0 = jnp.broadcast_to(one_mont, (Rp, L))
+        acc, _ = jax.lax.scan(step, acc0, digits)
+        return mul(acc, jnp.broadcast_to(one_plain, acc.shape))
+
+    fn = jax.jit(run)
+    with _FN_CACHE_LOCK:
+        while len(_FN_CACHE) >= _FN_CACHE_MAX:
+            _FN_CACHE.pop(next(iter(_FN_CACHE)), None)
+        _FN_CACHE[key] = fn
+    return fn
+
+
+def fold_weighted(
+    cs: list[int], weights: list[list[int]], modulus: int, kernel: str = "jnp"
+) -> list[int]:
+    """Per-row weighted modular products, one device dispatch:
+
+        out[r] = prod_j cs[j] ** weights[r][j]  mod modulus
+
+    The PC-MM kernel behind the Prism analytics plane (arxiv 2504.14497):
+    a plaintext-matrix x ciphertext-vector product over Paillier is exactly
+    this shape with modulus = n^2 and negative weights pre-encoded as
+    n - |w| by the caller (models/paillier.matvec_encode). Weights must be
+    non-negative ints below the modulus; rows must all span len(cs).
+
+    Structure: a shared 4-bit-window ladder over the longest weight's
+    digits — per digit, 4 batched squarings of the (R, L) accumulator,
+    one 16-entry table gather per (row, operand), and a halving tree fold
+    over the operand axis — so the work is R*K-wide batched Montgomery
+    multiplies end to end, the batch shape the MXU/VPU kernel families
+    were built for. Operands pad to a power of two with 1 (weight 0),
+    rows pad with all-zero weight vectors; both pads gather the identity
+    table entry, so padding never perturbs results.
+
+    Public parameters only (ciphertexts, plaintext weights, a public
+    modulus): nothing here touches secret key material, so ModCtx's global
+    cache and the persistent compile cache are safe — ADVICE.md's
+    secret-CRT-parameter concern does not apply to this path.
+    """
+    ctx = ModCtx.make(modulus)
+    K, R_real = len(cs), len(weights)
+    if K == 0 or R_real == 0:
+        raise ValueError("fold_weighted needs >= 1 operand and >= 1 row")
+    for row in weights:
+        if len(row) != K:
+            raise ValueError(
+                f"weight row spans {len(row)} operands, expected {K}"
+            )
+        for w in row:
+            if w < 0 or w >= modulus:
+                raise ValueError(
+                    "weights must be encoded to [0, modulus) before the "
+                    "kernel (negative weights: models/paillier.matvec_encode)"
+                )
+    P2 = 1 << max(0, (K - 1).bit_length())
+    Rp = 1 << max(0, (R_real - 1).bit_length())
+    arr = bn.ints_to_batch(list(cs) + [1] * (P2 - K), ctx.L)
+    E = max((w.bit_length() for row in weights for w in row), default=0)
+    D = max(1, -(-E // _WINDOW))
+    digits = np.zeros((D, Rp, P2), np.int32)
+    for r, row in enumerate(weights):
+        for k, w in enumerate(row):
+            for d in range(-(-w.bit_length() // _WINDOW)):
+                digits[D - 1 - d, r, k] = (w >> (_WINDOW * d)) & 0xF
+    fn = _fold_weighted_fn(ctx, kernel)
+    out = kprof.profiled(
+        "fold_weighted",
+        lambda: fn(jnp.asarray(arr), jnp.asarray(digits)),
+        R=R_real, K=K, D=D,
+    )
+    return [bn.limbs_to_int(row) for row in np.asarray(out)[:R_real]]
 
 
 def fold_many(folds: list[list[int]], modulus: int, kernel: str = "jnp") -> list[int]:

@@ -387,6 +387,9 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             trace_route_enabled=cfg.debug or cfg.obs.trace_route,
             metrics_route_enabled=cfg.obs.metrics_route,
             slo_route_enabled=cfg.obs.slo_route,
+            analytics_enabled=cfg.analytics.enabled,
+            analytics_max_rows=cfg.analytics.max_rows,
+            analytics_max_request_bytes=cfg.analytics.max_request_bytes,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),
@@ -560,6 +563,9 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
             trace_route_enabled=cfg.debug or cfg.obs.trace_route,
             metrics_route_enabled=cfg.obs.metrics_route,
             slo_route_enabled=cfg.obs.slo_route,
+            analytics_enabled=cfg.analytics.enabled,
+            analytics_max_rows=cfg.analytics.max_rows,
+            analytics_max_request_bytes=cfg.analytics.max_request_bytes,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),

@@ -269,6 +269,25 @@ class ShardConfig:
 
 
 @dataclass
+class AnalyticsConfig:
+    """Prism encrypted-analytics plane (dds_tpu/analytics): plaintext-
+    matrix x Paillier-ciphertext-vector products served as REST routes
+    (POST /MatVec, /WeightedSum, /GroupBySum). The proxy sees ciphertexts
+    and the client's PLAINTEXT weights — public parameters only, never
+    keys; DEPLOY.md "Encrypted analytics" documents the boundary. Note the
+    weights themselves are visible to the proxy: a deployment whose query
+    matrix is sensitive should not use these routes."""
+
+    enabled: bool = True
+    # per-request weight-row / group cap (bounds kernel work one request
+    # can demand; the DDS_ANALYTICS_MAX_ROWS env knob overrides, both
+    # validated by ops/flags.analytics_max_rows)
+    max_rows: int = 256
+    # request-body byte cap for the analytics routes (413 beyond; 0 = off)
+    max_request_bytes: int = 1048576
+
+
+@dataclass
 class AttackConfig:
     enabled: bool = False
     # crash | byzantine | partition | delay | flood | heal (the network
@@ -292,6 +311,7 @@ class DDSConfig:
     attacks: AttackConfig = field(default_factory=AttackConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     shard: ShardConfig = field(default_factory=ShardConfig)
+    analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
     debug: bool = False
 
     # ------------------------------------------------------------- loading
@@ -337,5 +357,6 @@ _SUBSECTIONS = {
     ("DDSConfig", "attacks"): AttackConfig,
     ("DDSConfig", "obs"): ObsConfig,
     ("DDSConfig", "shard"): ShardConfig,
+    ("DDSConfig", "analytics"): AnalyticsConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
 }

@@ -1,0 +1,505 @@
+"""Prism encrypted-analytics tests (ISSUE 6 acceptance surface).
+
+Covers the PC-MM pipeline end to end: the weighted-fold kernel against
+python-int modexp (including the full-width exponents the n-|w| negative
+encoding produces), backend parity (cpu / tpu / native, device and host
+crossover paths), the Paillier weight-encoding primitive, the REST route
+family decrypting to the plaintext W @ x (negative weights and zero rows
+included), bit-for-bit S=4 vs S=1 sharded equality over identical
+ciphertexts, a WrongShard fence healing mid-MatVec under a seeded
+ChaosNet schedule, the request limits / 4xx paths, the /metrics + /slo
+surface for the new routes, the DDS_ANALYTICS_MAX_ROWS validation, and
+the sentry --check contract for `analytics matvec` records.
+
+Everything here runs without the `cryptography` package: keys are
+512-bit or smaller, which `PaillierKey.generate` serves from the local
+prime generator (the PR 1 fallback), and the routes themselves touch
+public parameters only.
+"""
+
+import asyncio
+import contextlib
+import json
+import random
+
+import pytest
+
+from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
+from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
+from dds_tpu.core.transport import InMemoryNet
+from dds_tpu.http.miniserver import http_request
+from dds_tpu.http.server import DDSRestServer, ProxyConfig
+from dds_tpu.models.backend import get_backend
+from dds_tpu.models.paillier import PaillierKey
+from dds_tpu.ops.foldmany import fold_weighted
+
+pytestmark = pytest.mark.analytics
+
+rng = random.Random(41)
+KEY = PaillierKey.generate(512)  # local-prime path: no `cryptography` needed
+PK = KEY.public
+
+
+def _want_rows(cs, weights, modulus):
+    out = []
+    for row in weights:
+        acc = 1
+        for c, w in zip(cs, row):
+            acc = acc * pow(c, w, modulus) % modulus
+        out.append(acc)
+    return out
+
+
+# ------------------------------------------------------------------ kernel
+
+
+@pytest.mark.parametrize("kernel", ["jnp", "v2"])
+def test_fold_weighted_matches_int(kernel):
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    cs = [rng.randrange(1, n) for _ in range(5)]  # non-power-of-two K
+    weights = [
+        [rng.randrange(0, 1 << 20) for _ in range(5)] for _ in range(3)
+    ]
+    weights[1][2] = 0           # zero weight gathers the identity entry
+    weights[2] = [0] * 5        # an all-zero row must come back as 1
+    got = fold_weighted(cs, weights, n, kernel=kernel)
+    assert got == _want_rows(cs, weights, n)
+
+
+def test_fold_weighted_full_width_negative_encoding():
+    """The n-|w| encoding makes negative weights full-n-width exponents;
+    the digit ladder must stay exact across hundreds of scan steps."""
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    cs = [rng.randrange(1, n) for _ in range(2)]
+    weights = [[n - 5, 3]]
+    assert fold_weighted(cs, weights, n) == _want_rows(cs, weights, n)
+
+
+def test_fold_weighted_rejects_bad_shapes():
+    n = (1 << 127) - 1
+    with pytest.raises(ValueError):
+        fold_weighted([], [[1]], n)
+    with pytest.raises(ValueError):
+        fold_weighted([3, 5], [[1]], n)       # row narrower than operands
+    with pytest.raises(ValueError):
+        fold_weighted([3], [[-1]], n)         # unencoded negative
+    with pytest.raises(ValueError):
+        fold_weighted([3], [[n]], n)          # exponent >= modulus
+
+
+def test_backend_matvec_parity():
+    """cpu / native / tpu (device path AND host-crossover path) all agree
+    with python ints over one input set."""
+    n2 = PK.nsquare
+    cs = [PK.encrypt_fast(rng.randrange(1 << 20)) for _ in range(4)]
+    enc = PK.matvec_encode(
+        [[rng.randrange(-9, 9) for _ in range(4)] for _ in range(3)]
+    )
+    want = _want_rows(cs, enc, n2)
+    assert get_backend("cpu").matvec(cs, enc, n2) == want
+    assert get_backend("native").matvec(cs, enc, n2) == want
+    from dds_tpu.models.backend import TpuBackend
+
+    assert TpuBackend(pallas=False, min_device_batch=0).matvec(
+        cs, enc, n2) == want                      # device weighted fold
+    assert TpuBackend(pallas=False, min_device_batch=10**6).matvec(
+        cs, enc, n2) == want                      # below-crossover host loop
+
+
+# ------------------------------------------------------------------ encoding
+
+
+def test_matvec_encode_signed_and_bounds():
+    n = PK.n
+    enc = PK.matvec_encode([[3, -4, 0]])
+    assert enc == [[3, n - 4, 0]]
+    with pytest.raises(ValueError):
+        PK.matvec_encode([[n]])
+    with pytest.raises(ValueError):
+        PK.matvec_encode([[-n]])
+    # the host reference composes with the encoding: decrypt == W @ x
+    xs = [rng.randrange(1 << 16) for _ in range(3)]
+    cs = [PK.encrypt_fast(x) for x in xs]
+    W = [[2, -3, 1], [0, 0, 0]]
+    out = PK.matvec(cs, PK.matvec_encode(W))
+    got = [KEY.to_signed(KEY.decrypt(c)) for c in out]
+    assert got == [sum(w * x for w, x in zip(row, xs)) for row in W]
+
+
+def test_flags_analytics_max_rows(monkeypatch):
+    from dds_tpu.ops.flags import analytics_max_rows
+
+    monkeypatch.delenv("DDS_ANALYTICS_MAX_ROWS", raising=False)
+    assert analytics_max_rows() == 256
+    assert analytics_max_rows(17) == 17
+    monkeypatch.setenv("DDS_ANALYTICS_MAX_ROWS", "64")
+    assert analytics_max_rows(17) == 64          # env wins over config
+    for bad in ("zero", "0", "-3", "9999999"):
+        monkeypatch.setenv("DDS_ANALYTICS_MAX_ROWS", bad)
+        with pytest.raises(ValueError):
+            analytics_max_rows()
+    monkeypatch.delenv("DDS_ANALYTICS_MAX_ROWS", raising=False)
+    with pytest.raises(ValueError):
+        analytics_max_rows(0)                    # config value validated too
+
+
+# ------------------------------------------------------------------ REST
+
+
+@contextlib.asynccontextmanager
+async def rest_stack(n=4, quorum=3, **proxy_kw):
+    net = InMemoryNet()
+    addrs = [f"replica-{i}" for i in range(n)]
+    replicas = {
+        a: BFTABDNode(a, addrs, "supervisor", net,
+                      ReplicaConfig(quorum_size=quorum))
+        for a in addrs
+    }
+    abd = AbdClient("proxy-0", net, addrs, AbdClientConfig(quorum_size=quorum))
+    server = DDSRestServer(
+        abd, ProxyConfig(host="127.0.0.1", port=0, **proxy_kw)
+    )
+    await server.start()
+    try:
+        yield server, replicas
+    finally:
+        await server.stop()
+
+
+async def call(server, method, target, obj=None, raw=None):
+    body = raw if raw is not None else (
+        json.dumps(obj).encode() if obj is not None else None
+    )
+    return await http_request(
+        "127.0.0.1", server.cfg.port, method, target, body, timeout=30.0
+    )
+
+
+async def _put_rows(server, xs):
+    """Store one single-column encrypted record per value; returns
+    key -> plaintext for all of them."""
+    keymap = {}
+    for x in xs:
+        st, key = await call(
+            server, "POST", "/PutSet", {"contents": [str(PK.encrypt_fast(x))]}
+        )
+        assert st == 200
+        keymap[key.decode()] = x
+    return keymap
+
+
+def test_rest_matvec_decrypts_to_plaintext_matmul():
+    async def go():
+        async with rest_stack() as (server, _):
+            xs = [rng.randrange(1 << 20) for _ in range(5)]
+            keymap = await _put_rows(server, xs)
+            W = [[rng.randrange(-50, 50) for _ in range(5)] for _ in range(3)]
+            W[2] = [0] * 5                       # zero row -> Enc(0)
+            st, body = await call(
+                server, "POST", f"/MatVec?position=0&nsqr={PK.nsquare}",
+                {"weights": W},
+            )
+            assert st == 200
+            d = json.loads(body)
+            assert d["keys"] == sorted(keymap)   # column order is echoed
+            col = [keymap[k] for k in d["keys"]]
+            got = [KEY.to_signed(KEY.decrypt(int(c))) for c in d["result"]]
+            assert got == [sum(w * x for w, x in zip(row, col)) for row in W]
+
+            # WeightedSum = the one-row special case
+            row = [1, -1, 2, 0, -3]
+            st, body = await call(
+                server, "POST", f"/WeightedSum?position=0&nsqr={PK.nsquare}",
+                {"weights": row},
+            )
+            assert st == 200
+            d = json.loads(body)
+            got = KEY.to_signed(KEY.decrypt(int(d["result"])))
+            assert got == sum(w * x for w, x in zip(row, col))
+
+    asyncio.run(go())
+
+
+def test_rest_groupby_sum_selector_rollups():
+    async def go():
+        async with rest_stack() as (server, _):
+            xs = [rng.randrange(1 << 20) for _ in range(6)]
+            keymap = await _put_rows(server, xs)
+            keys = sorted(keymap)
+            groups = {"evens": keys[0::2], "odds": keys[1::2]}
+            st, body = await call(
+                server, "POST", f"/GroupBySum?position=0&nsqr={PK.nsquare}",
+                {"groups": groups},
+            )
+            assert st == 200
+            result = json.loads(body)["result"]
+            for label, members in groups.items():
+                got = KEY.decrypt(int(result[label]))
+                assert got == sum(keymap[k] for k in members)
+            # a group naming an unknown key is a bad request, not a
+            # silently-smaller rollup
+            st, body = await call(
+                server, "POST", f"/GroupBySum?position=0&nsqr={PK.nsquare}",
+                {"groups": {"g": [keys[0], "NOT-A-KEY"]}},
+            )
+            assert st == 400 and b"unknown record key" in body
+
+    asyncio.run(go())
+
+
+def test_rest_analytics_limits_and_4xx():
+    async def go():
+        async with rest_stack(
+            analytics_max_rows=2, analytics_max_request_bytes=4096
+        ) as (server, _):
+            nsqr = PK.nsquare
+            # no stored records yet -> 404 (like SumAll over an empty store)
+            st, _ = await call(
+                server, "POST", f"/MatVec?position=0&nsqr={nsqr}",
+                {"weights": [[1]]},
+            )
+            assert st == 404
+            keymap = await _put_rows(server, [5, 7])
+            ok = [[1, 2]]
+            st, _ = await call(
+                server, "POST", f"/MatVec?position=0&nsqr={nsqr}",
+                {"weights": ok},
+            )
+            assert st == 200
+            # row cap (the validated DDS_ANALYTICS_MAX_ROWS knob)
+            st, body = await call(
+                server, "POST", f"/MatVec?position=0&nsqr={nsqr}",
+                {"weights": [[1, 2]] * 3},
+            )
+            assert st == 400 and b"row cap" in body
+            # width mismatch against the stored operand columns
+            st, _ = await call(
+                server, "POST", f"/MatVec?position=0&nsqr={nsqr}",
+                {"weights": [[1, 2, 3]]},
+            )
+            assert st == 400
+            # non-integer weights (bool is NOT 1/0 here)
+            for bad in ([[True, 2]], [["x", 2]], [[1.5, 2]], "nope", {}):
+                st, _ = await call(
+                    server, "POST", f"/MatVec?position=0&nsqr={nsqr}",
+                    {"weights": bad} if not isinstance(bad, str) else bad,
+                )
+                assert st == 400, bad
+            # nsqr must be a perfect square (a Paillier n^2)
+            st, _ = await call(
+                server, "POST", f"/MatVec?position=0&nsqr={nsqr + 1}",
+                {"weights": ok},
+            )
+            assert st == 400
+            # WeightedSum takes a flat row, not a matrix
+            st, _ = await call(
+                server, "POST", f"/WeightedSum?position=0&nsqr={nsqr}",
+                {"weights": [[1, 2]]},
+            )
+            assert st == 400
+            # oversize body -> 413 before JSON parsing
+            st, _ = await call(
+                server, "POST", f"/MatVec?position=0&nsqr={nsqr}",
+                raw=b"x" * 5000,
+            )
+            assert st == 413
+            # negative position never indexes from the end
+            st, _ = await call(
+                server, "POST", f"/MatVec?position=-1&nsqr={nsqr}",
+                {"weights": ok},
+            )
+            assert st == 400
+
+        # routes vanish when the plane is disabled
+        async with rest_stack(analytics_enabled=False) as (server, _):
+            await _put_rows(server, [5])
+            st, _ = await call(
+                server, "POST", f"/MatVec?position=0&nsqr={PK.nsquare}",
+                {"weights": [[1]]},
+            )
+            assert st == 404
+
+    asyncio.run(go())
+
+
+def test_rest_analytics_metrics_and_slo_surface():
+    async def go():
+        async with rest_stack() as (server, _):
+            await _put_rows(server, [3, 9])
+            st, _ = await call(
+                server, "POST", f"/MatVec?position=0&nsqr={PK.nsquare}",
+                {"weights": [[1, 1]]},
+            )
+            assert st == 200
+            st, body = await call(server, "GET", "/metrics")
+            text = body.decode()
+            for fam in ("dds_analytics_requests_total",
+                        "dds_analytics_rows",
+                        "dds_analytics_matvec_seconds"):
+                assert fam in text, fam
+            assert 'route="MatVec"' in text
+            st, body = await call(server, "GET", "/slo")
+            assert st == 200
+            assert "MatVec" in json.loads(body)["slo"]["routes"]
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------------------ sharded
+
+
+def _constellation(S, net=None, seed=3, **kw):
+    from dds_tpu.shard import build_constellation
+
+    net = net or InMemoryNet()
+    kw.setdefault("n_active", 4)
+    kw.setdefault("n_sentinent", 0)
+    kw.setdefault("quorum", 3)
+    return build_constellation(net, shard_count=S, vnodes_per_group=8,
+                               seed=seed, **kw), net
+
+
+def test_sharded_matvec_bit_for_bit_s4_vs_s1():
+    """The sharded scatter-gather MatVec must be BIT-identical to the
+    single-group evaluation over the same ciphertexts: shards share one
+    Paillier modulus and the row product is associative/commutative over
+    any column partition."""
+    xs = [rng.randrange(1 << 20) for _ in range(6)]
+    rows = [[str(PK.encrypt_fast(x))] for x in xs]  # ONE encryption, both runs
+    W = [[rng.randrange(-20, 20) for _ in range(6)] for _ in range(3)]
+
+    async def serve(S):
+        const, _ = _constellation(S)
+        server = DDSRestServer(const.router, ProxyConfig(port=0))
+        await server.start()
+        try:
+            for row in rows:
+                st, _ = await http_request(
+                    "127.0.0.1", server.cfg.port, "POST", "/PutSet",
+                    json.dumps({"contents": row}).encode(), timeout=10.0,
+                )
+                assert st == 200
+            if S > 1:  # the sample must genuinely span groups
+                assert len(const.router.partition_keys(
+                    sorted(server.stored_keys))) > 1
+            st, body = await http_request(
+                "127.0.0.1", server.cfg.port, "POST",
+                f"/MatVec?position=0&nsqr={PK.nsquare}",
+                json.dumps({"weights": W}).encode(), timeout=60.0,
+            )
+            assert st == 200
+            return json.loads(body)
+        finally:
+            await server.stop()
+            await const.stop()
+
+    async def go():
+        single = await serve(1)
+        sharded = await serve(4)
+        assert sharded == single                  # bit-for-bit, keys included
+        # and it decrypts to the plaintext matmul
+        from dds_tpu.utils import sigs
+
+        bykey = {sigs.key_from_set(row): x for row, x in zip(rows, xs)}
+        xcol = [bykey[k] for k in single["keys"]]
+        got = [KEY.to_signed(KEY.decrypt(int(c))) for c in single["result"]]
+        assert got == [sum(w * x for w, x in zip(r, xcol)) for r in W]
+
+    asyncio.run(go())
+
+
+@pytest.mark.chaos
+def test_wrong_shard_retry_mid_matvec_chaosnet():
+    """A seeded ChaosNet schedule with delivery jitter, plus an epoch+1
+    fence installed on one group while a MatVec is in flight: the fenced
+    quorum round surfaces WrongShardError, the proxy's deadline-budgeted
+    retry spins, and once the fence rolls back (the abort path's
+    force-install) the SAME request completes correctly — no 5xx, no
+    misroute, wrong-shard retries visible in metrics."""
+    from dds_tpu.core.chaos import ChaosNet, LinkFaults
+    from dds_tpu.obs.metrics import metrics
+    from dds_tpu.shard.shardmap import ShardMap
+
+    async def go():
+        net = ChaosNet(InMemoryNet(), seed=606)
+        net.default_faults = LinkFaults(delay=0.002, jitter=0.004)
+        const, _ = _constellation(2, net=net, seed=9)
+        server = DDSRestServer(const.router, ProxyConfig(port=0))
+        await server.start()
+        try:
+            xs = []
+            while True:  # store until the sample spans BOTH groups
+                x = rng.randrange(1 << 16)
+                st, _ = await http_request(
+                    "127.0.0.1", server.cfg.port, "POST", "/PutSet",
+                    json.dumps(
+                        {"contents": [str(PK.encrypt_fast(x))]}
+                    ).encode(), timeout=10.0,
+                )
+                assert st == 200
+                xs.append(x)
+                if len(xs) >= 4 and len(const.router.partition_keys(
+                        sorted(server.stored_keys))) == 2:
+                    break
+                assert len(xs) < 32  # 2^-31-unlucky, not a bug
+            before = metrics.value(
+                "dds_wrong_shard_retries_total", shard="s1") or 0
+            old = const.manager.current()
+            secret = const.secret
+            # freeze s1 out of the whole keyspace under epoch+1 (the
+            # router keeps serving the old map: a stale route)
+            fence = ShardMap(
+                old.epoch + 1, tuple((p, "s0") for p, _ in old.vnodes),
+                ("s0",),
+            ).sign(secret)
+            const.group("s1").state.install(fence)
+
+            async def heal():
+                await asyncio.sleep(0.15)
+                const.group("s1").state.install(old, force=True)
+
+            matvec = http_request(
+                "127.0.0.1", server.cfg.port, "POST",
+                f"/MatVec?position=0&nsqr={PK.nsquare}",
+                json.dumps({"weights": [[1] * len(xs)]}).encode(),
+                timeout=30.0,
+            )
+            (st, body), _ = await asyncio.gather(matvec, heal())
+            assert st == 200
+            got = KEY.decrypt(int(json.loads(body)["result"][0]))
+            assert got == sum(xs)
+            after = metrics.value(
+                "dds_wrong_shard_retries_total", shard="s1") or 0
+            assert after > before  # the fence really interposed mid-request
+        finally:
+            await server.stop()
+            await const.stop()
+            net.heal_all()
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------------------ sentry
+
+
+def test_sentry_check_parses_analytics_records(tmp_path):
+    from benchmarks.sentry import _check_analytics_records
+
+    bench = tmp_path / "benchmarks"
+    bench.mkdir()
+    good = {
+        "metric": "analytics matvec: Enc(W·x) rows/s @ 2x8, 256-bit",
+        "value": 100.0, "unit": "rows/s", "vs_baseline": 2.0,
+        "detail": {"rows": 2, "cols": 8, "server_ms": 1.0, "client_ms": 2.0},
+    }
+    (bench / "results.json").write_text(json.dumps([good]))
+    assert _check_analytics_records(str(tmp_path)) == {"rows": 1}
+    bad = dict(good, detail={"rows": 2})         # missing timings
+    (bench / "results.json").write_text(json.dumps([good, bad]))
+    with pytest.raises(ValueError):
+        _check_analytics_records(str(tmp_path))
+    # other record families are ignored by this checker
+    (bench / "results.json").write_text(
+        json.dumps([{"metric": "shard scaling: whatever", "value": -1}])
+    )
+    assert _check_analytics_records(str(tmp_path)) == {"rows": 0}

@@ -86,6 +86,72 @@ def test_sharded_reduce_non_power_of_two_mesh(D, K):
     assert bn.limbs_to_int(np.asarray(out)[0]) == want
 
 
+# ----------------------------------- scatter-gather tail combine edge cases
+
+
+def test_combine_partials_empty_partition_raises():
+    """An empty per-shard partition is a caller bug (the scatter path
+    filters empty groups before dispatch): it must fail loudly, never
+    invent a neutral result for an aggregate nobody computed."""
+    from dds_tpu.parallel.mesh import combine_partials
+
+    with pytest.raises(ValueError):
+        combine_partials([], 97)
+
+
+def test_combine_partials_single_shard_identity():
+    """One shard owning every operand must combine to exactly its own
+    partial (reduced mod n) — the S=1 degenerate case the router's
+    single-group fast path relies on."""
+    from dds_tpu.parallel.mesh import combine_partials
+
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    p = rng.randrange(n)
+    assert combine_partials([p], n) == p
+    assert combine_partials([p + n], n) == p  # unreduced input normalizes
+
+
+def test_combine_partials_neutral_elements():
+    """Neutral-element handling for both aggregate families: a shard whose
+    fold saw no effective operands contributes 1 (the modular-product
+    identity) for SumAll (mod n^2 ciphertext adds) AND MultAll (mod n
+    ciphertext products), and must never perturb the combined result."""
+    from dds_tpu.parallel.mesh import combine_partials
+
+    n = rng.getrandbits(128) | (1 << 127) | 1
+    for modulus in (n, n * n):  # MultAll-style (n) and SumAll-style (n^2)
+        ps = [rng.randrange(1, modulus) for _ in range(3)]
+        want = 1
+        for p in ps:
+            want = want * p % modulus
+        assert combine_partials(ps, modulus) == want
+        # identity partials interleaved anywhere leave the result unchanged
+        assert combine_partials([1] + ps[:1] + [1, 1] + ps[1:], modulus) == want
+        assert combine_partials([1, 1, 1], modulus) == 1
+
+
+@pytest.mark.parametrize("parts", [2, 3, 5, 7])
+def test_combine_partials_matches_flat_fold_any_partition(parts):
+    """Partition-independence: however K operands split across shards,
+    the combined per-shard partials equal the flat fold bit-for-bit —
+    the invariant the sharded SumAll/MatVec equality tests build on."""
+    from dds_tpu.parallel.mesh import combine_partials
+
+    n = rng.getrandbits(256) | (1 << 255) | 1
+    ops = [rng.randrange(1, n) for _ in range(23)]
+    flat = 1
+    for o in ops:
+        flat = flat * o % n
+    cuts = sorted(rng.sample(range(1, len(ops)), parts - 1))
+    partials = []
+    for lo, hi in zip([0] + cuts, cuts + [len(ops)]):
+        p = 1
+        for o in ops[lo:hi]:
+            p = p * o % n
+        partials.append(p)
+    assert combine_partials(partials, n) == flat
+
+
 # ------------------------------------------- fast kernels under the mesh
 
 @pytest.mark.parametrize("kernel", ["v1", "v2"])
