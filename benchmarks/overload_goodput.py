@@ -1,0 +1,253 @@
+"""Overload goodput: Bulwark admission control vs the 503 cliff.
+
+The claim behind ISSUE 7: under sustained overload, a proxy WITHOUT a
+decision loop lets every request burn its full Deadline budget before
+503ing and lets aggregate floods starve interactive point ops; with
+Bulwark (core/admission) the flood is rejected at the edge in
+microseconds, so interactive goodput survives.
+
+The harness drives ONE seeded schedule twice — admission off (baseline),
+then on (bulwark) — against a fresh 4-replica deployment each time:
+
+- a seeded ChaosNet fabric with Nemesis `delay` + periodic `flood`
+  attacks (the ISSUE's "ChaosNet flood/overload schedule");
+- an OPEN-LOOP arrival schedule (arrivals fire at their scheduled time
+  regardless of completions — coordinated-omission-safe): an interactive
+  stream of GetSet point reads plus an aggregate flood of SumAll folds at
+  several times the fabric's capacity.
+
+Reported record (`overload goodput`, parsed by benchmarks/sentry.py
+--check): value = Bulwark-run interactive goodput (requests answering
+200 under --good-latency-ms, per second), vs_baseline = bulwark /
+baseline goodput, detail = both runs' status censuses, shed counts and
+shed-latency percentiles (shed rejections must complete in MICROSECONDS,
+not Deadline budgets — that is the other half of the claim).
+
+Usage: python -m benchmarks.overload_goodput [--duration 3] [--keys 256]
+       [--interactive-rate 30] [--aggregate-rate 400] [--seed 11]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+import time
+
+from benchmarks.common import emit
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    """Nearest-rank percentile; 0 for an empty sample."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, max(0, int(q * len(xs)) - 1))]
+
+
+def _config(args, admission: bool):
+    from dds_tpu.utils.config import DDSConfig
+
+    cfg = DDSConfig()
+    cfg.replicas.endpoints = [f"replica-{i}" for i in range(4)]
+    cfg.replicas.sentinent = []
+    cfg.replicas.byz_quorum_size = 3
+    cfg.replicas.byz_max_faults = 1
+    cfg.proxy.port = 0
+    cfg.proxy.request_budget = args.budget
+    cfg.proxy.intranet_request_timeout = args.budget / 2
+    # quiet fabric: the bench measures admission, not recovery timers
+    cfg.recovery.enabled = False
+    cfg.recovery.anti_entropy_enabled = False
+    cfg.obs.audit_enabled = False
+    # short burn windows so the shedding ratchet can react within the run
+    cfg.obs.slo_fast_window = 1.0
+    cfg.obs.slo_slow_window = 2.0
+    cfg.obs.slo_latency_ms = args.good_latency_ms
+    cfg.attacks.enabled = True
+    cfg.attacks.chaos_enabled = True
+    cfg.attacks.chaos_seed = args.seed
+    cfg.admission.enabled = admission
+    cfg.admission.eval_interval = 0.2
+    cfg.admission.shed_hold = 4
+    # the aggregate bucket is the star: a few folds/s sustained, the rest
+    # answer 429 in microseconds instead of entering the quorum machinery
+    cfg.admission.aggregate_rate = args.admit_aggregate_rate
+    cfg.admission.aggregate_burst = args.admit_aggregate_rate
+    cfg.admission.interactive_rate = args.interactive_rate * 4
+    cfg.admission.interactive_burst = args.interactive_rate * 8
+    return cfg
+
+
+async def _drive(args, admission: bool) -> dict:
+    from dds_tpu.http.miniserver import http_request
+    from dds_tpu.run import launch
+
+    cfg = _config(args, admission)
+    dep = await launch(cfg)
+    rng = random.Random(args.seed)
+    host, port = cfg.proxy.host, dep.server.cfg.port
+    modulus = (1 << args.bits) - 159  # fixed odd fold modulus
+
+    async def call(method, target, obj=None):
+        import json as _json
+
+        body = _json.dumps(obj).encode() if obj is not None else None
+        t0 = time.perf_counter()
+        try:
+            status, _ = await http_request(
+                host, port, method, target, body,
+                timeout=args.budget + 2.0,
+            )
+        except (OSError, asyncio.TimeoutError, EOFError, ConnectionError):
+            status = -1  # client-visible failure (timeout/reset)
+        return status, time.perf_counter() - t0
+
+    # seed the store: K single-column records of `bits`-bit "ciphertexts"
+    # (random residues stand in for Paillier ciphertexts — the fold and
+    # the protocol cost are identical, and the HE layer is orthogonal to
+    # the admission claim)
+    import json as _json
+
+    keys = []
+    for _ in range(args.keys):
+        status, body = await http_request(
+            host, port, "POST", "/PutSet",
+            _json.dumps(
+                {"contents": [str(rng.getrandbits(args.bits) % modulus)]}
+            ).encode(),
+            timeout=10.0,
+        )
+        if status != 200:
+            raise RuntimeError(f"store seeding failed with {status}")
+        keys.append(body.decode())
+
+    # open-loop schedule, identical for both variants: arrival offsets are
+    # drawn from the SAME seeded rng stream (uniform jitter around the
+    # nominal inter-arrival gap)
+    sched_rng = random.Random(args.seed + 1)
+
+    def arrivals(rate: float) -> list[float]:
+        out, t = [], 0.0
+        while t < args.duration:
+            out.append(t)
+            t += sched_rng.uniform(0.5, 1.5) / rate
+        return out
+
+    interactive = [("interactive", t) for t in arrivals(args.interactive_rate)]
+    aggregate = [("aggregate", t) for t in arrivals(args.aggregate_rate)]
+    schedule = sorted(interactive + aggregate, key=lambda p: p[1])
+    results: list[tuple[str, int, float]] = []
+
+    async def fire(klass: str):
+        if klass == "interactive":
+            key = keys[sched_rng.randrange(len(keys))]
+            status, lat = await call("GET", f"/GetSet/{key}")
+        else:
+            status, lat = await call(
+                "GET", f"/SumAll?position=0&nsqr={modulus}"
+            )
+        results.append((klass, status, lat))
+
+    async def nemesis():
+        # the ChaosNet overload schedule: one delay attack up front, then
+        # periodic junk floods at the replicas for the whole run
+        dep.trudy.trigger("delay")
+        while True:
+            await asyncio.sleep(0.3)
+            dep.trudy.trigger("flood")
+
+    chaos = asyncio.ensure_future(nemesis())
+    t0 = time.perf_counter()
+    pending = []
+    for klass, at in schedule:
+        delay = at - (time.perf_counter() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        pending.append(asyncio.ensure_future(fire(klass)))
+    await asyncio.wait_for(asyncio.gather(*pending), args.budget + 10.0)
+    wall = time.perf_counter() - t0
+    chaos.cancel()
+    try:
+        await chaos
+    except asyncio.CancelledError:
+        pass
+    shed_level = dep.server.admission.shed_level if dep.server.admission else 0
+    transitions = (
+        len(dep.server.admission.transitions) if dep.server.admission else 0
+    )
+    await dep.stop()
+
+    good_s = args.good_latency_ms / 1e3
+    census: dict[str, dict[str, int]] = {}
+    for klass, status, _ in results:
+        c = census.setdefault(klass, {})
+        label = str(status) if status > 0 else "client_error"
+        c[label] = c.get(label, 0) + 1
+    goodput = sum(
+        1 for klass, status, lat in results
+        if klass == "interactive" and status == 200 and lat <= good_s
+    ) / wall
+    # shed/throttled rejections (admission 429s + degraded 503s): the
+    # "fail in microseconds, not budgets" half of the acceptance claim
+    shed_lat = [lat for _, status, lat in results if status in (429, 503)]
+    return {
+        "goodput": goodput,
+        "wall_s": round(wall, 3),
+        "census": census,
+        "shed_requests": len(shed_lat),
+        "shed_p50_ms": round(_percentile(shed_lat, 0.50) * 1e3, 3),
+        "shed_p95_ms": round(_percentile(shed_lat, 0.95) * 1e3, 3),
+        "shed_level_final": shed_level,
+        "shed_transitions": transitions,
+    }
+
+
+def main(argv=None) -> list:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="open-loop schedule length (s) per variant")
+    ap.add_argument("--keys", type=int, default=256,
+                    help="stored records (aggregate fold width)")
+    ap.add_argument("--interactive-rate", type=float, default=30.0,
+                    help="interactive GetSet arrivals/s")
+    ap.add_argument("--aggregate-rate", type=float, default=400.0,
+                    help="aggregate SumAll arrivals/s (the overload)")
+    ap.add_argument("--admit-aggregate-rate", type=float, default=8.0,
+                    help="Bulwark per-tenant aggregate bucket rate/burst")
+    ap.add_argument("--budget", type=float, default=1.5,
+                    help="proxy request budget (s)")
+    ap.add_argument("--good-latency-ms", type=float, default=300.0,
+                    help="latency bound for a request to count as goodput")
+    ap.add_argument("--bits", type=int, default=4096,
+                    help="stored ciphertext width")
+    ap.add_argument("--seed", type=int, default=11)
+    args = ap.parse_args(argv)
+
+    baseline = asyncio.run(_drive(args, admission=False))
+    bulwark = asyncio.run(_drive(args, admission=True))
+
+    row = emit(
+        "overload goodput interactive",
+        bulwark["goodput"],
+        "req/s",
+        bulwark["goodput"] / max(baseline["goodput"], 1e-9),
+        duration_s=args.duration,
+        interactive_rate=args.interactive_rate,
+        aggregate_rate=args.aggregate_rate,
+        keys=args.keys,
+        budget_s=args.budget,
+        good_latency_ms=args.good_latency_ms,
+        baseline_goodput=round(baseline["goodput"], 3),
+        shed_requests=bulwark["shed_requests"],
+        shed_p50_ms=bulwark["shed_p50_ms"],
+        shed_p95_ms=bulwark["shed_p95_ms"],
+        shed_transitions=bulwark["shed_transitions"],
+        baseline=baseline,
+        bulwark=bulwark,
+    )
+    return [row]
+
+
+if __name__ == "__main__":
+    main()

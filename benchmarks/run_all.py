@@ -19,8 +19,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from benchmarks import (analytics_matvec, audit_cost, bft_sum, crossover,
-                            encrypt_modexp, mixed, product, put_concurrency,
-                            shard_scaling, sweep)
+                            encrypt_modexp, mixed, overload_goodput, product,
+                            put_concurrency, shard_scaling, sweep)
 
     rows = []
     if args.quick:
@@ -34,6 +34,10 @@ def main(argv=None):
         rows += analytics_matvec.main(
             ["--shapes", "2x8", "--bits", "256", "--repeats", "1"]
         )
+        rows += overload_goodput.main(
+            ["--duration", "1.5", "--keys", "32", "--bits", "1024",
+             "--interactive-rate", "15", "--aggregate-rate", "120"]
+        )
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -45,6 +49,7 @@ def main(argv=None):
         rows += encrypt_modexp.main([])
         rows += shard_scaling.main([])
         rows += analytics_matvec.main([])
+        rows += overload_goodput.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

@@ -6,10 +6,11 @@ Modes (all emit one JSON line to stdout):
         Parse + validate the stored baseline file only (no kernels run;
         no jax import) — the CPU-only smoke CI runs so a corrupted
         baseline is caught before it silently disables gating.
-        Also parses any `shard scaling` (benchmarks/shard_scaling.py) and
-        `analytics matvec` (benchmarks/analytics_matvec.py) records in
+        Also parses any `shard scaling` (benchmarks/shard_scaling.py),
+        `analytics matvec` (benchmarks/analytics_matvec.py) and
+        `overload goodput` (benchmarks/overload_goodput.py) records in
         benchmarks/results.json / results_quick.json so a malformed
-        scaling or analytics record is caught by the same smoke.
+        scaling, analytics or overload record is caught by the same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -145,6 +146,39 @@ def _check_analytics_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_overload_records(root: str = REPO) -> dict:
+    """Validate `overload goodput` rows (benchmarks/overload_goodput.py):
+    positive goodput value, a detail block naming the baseline goodput
+    (the comparison the record exists for) and the shed census — count
+    plus a non-negative shed-latency p95. Same malformed contract as the
+    shard/analytics rows: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("overload goodput")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("baseline_goodput"), (int, float))
+            and detail["baseline_goodput"] >= 0
+            and isinstance(detail.get("shed_requests"), int)
+            and detail["shed_requests"] >= 0
+            and isinstance(detail.get("shed_p95_ms"), (int, float))
+            and detail["shed_p95_ms"] >= 0
+            and isinstance(detail.get("aggregate_rate"), (int, float))
+            and detail["aggregate_rate"] > 0
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed overload-goodput record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _load_fresh(path: str) -> dict:
     """A stats JSON: either the baseline schema or a bare kernels dict."""
     with open(path) as f:
@@ -186,6 +220,7 @@ def main(argv=None) -> int:
         try:
             shard = _check_shard_records()
             analytics = _check_analytics_records()
+            overload = _check_overload_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -195,6 +230,7 @@ def main(argv=None) -> int:
             "kernels": len(baseline), "exists": bool(baseline),
             "shard_scaling_rows": shard["rows"],
             "analytics_rows": analytics["rows"],
+            "overload_rows": overload["rows"],
         }))
         return 0
 

@@ -21,6 +21,25 @@ class ByzUnknownReplyError(ByzantineError):
     """Reply type made no sense for the outstanding request."""
 
 
+class AllBreakersOpenError(Exception):
+    """Every trusted coordinator's circuit breaker is open AND none will
+    half-open within the caller's remaining budget — the attempt is
+    provably futile, so the storage layer degrades immediately instead of
+    burning the Deadline on timeouts against targets it already knows are
+    refusing traffic (Bulwark fast-fail, core/admission). NOT a
+    ByzantineError: nobody misbehaved, the fabric is just down. `eta` is
+    the nearest half-open probe in seconds — the REST edge derives
+    Retry-After from it."""
+
+    def __init__(self, eta: float, targets: int = 0):
+        self.eta = eta
+        self.targets = targets
+        super().__init__(
+            f"all {targets} trusted coordinators have open breakers "
+            f"(nearest half-open probe in {eta:.3f}s)"
+        )
+
+
 class WrongShardError(Exception):
     """The addressed replica group does not own the key under its current
     shard map (Constellation epoch fencing, dds_tpu/shard). NOT a

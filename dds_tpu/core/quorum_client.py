@@ -29,6 +29,7 @@ from typing import Optional
 
 from dds_tpu.core import messages as M
 from dds_tpu.core.errors import (
+    AllBreakersOpenError,
     ByzFailedNonceChallengeError,
     ByzInvalidKeyError,
     ByzInvalidSignatureError,
@@ -71,6 +72,14 @@ class AbdClientConfig:
     # Constellation shard label for this client's metric series (empty =
     # unsharded, series keep their historical label sets)
     shard: str = ""
+    # Bulwark fast-fail (core/admission): when EVERY trusted coordinator's
+    # breaker is open and none will half-open within the caller's
+    # remaining Deadline budget, raise AllBreakersOpenError immediately
+    # instead of burning the budget on attempts that are provably futile.
+    # The guard is deliberately that narrow: while a probe still fits the
+    # budget, the degraded try (which may close a breaker) proceeds as
+    # before, so nothing heals slower.
+    fast_fail_all_open: bool = True
 
 
 class AbdClient:
@@ -156,6 +165,25 @@ class AbdClient:
         """Current breaker state per coordinator (for the /health route)."""
         return {n: b.state for n, b in sorted(self.breakers.items())}
 
+    def breaker_census(self) -> tuple[int, list[float]]:
+        """(trusted coordinator count, half-open ETAs of the ones whose
+        breaker currently refuses traffic) — the breaker-health signal the
+        Bulwark shedding controller and the Retry-After derivation read."""
+        trusted = self.replicas.get_trusted()
+        etas = []
+        for n in trusted:
+            b = self.breakers.get(n)
+            if b is not None and not b.allow():
+                etas.append(b.half_open_eta())
+        return len(trusted), etas
+
+    def min_half_open_eta(self) -> float | None:
+        """Nearest half-open probe among refusing breakers (None = no
+        breaker is refusing, or none exist)."""
+        _, etas = self.breaker_census()
+        positive = [e for e in etas if e > 0]
+        return min(positive) if positive else None
+
     def _coord_failed(self, coord: str) -> None:
         """A coordinator answered with a PROTOCOL VIOLATION: permanent
         suspicion strike (cryptographic evidence, never decays) plus a
@@ -217,6 +245,7 @@ class AbdClient:
         # trusted set when everything is excluded (a degraded try beats
         # instant failure, and a success closes the breaker again)
         blocked = tuple(n for n, b in self.breakers.items() if not b.allow())
+        self._maybe_fast_fail(blocked, deadline, op)
         timeout = self._attempt_timeout(deadline)
         coordinator = self.replicas.defer_to(
             tuple(exclude) + blocked, prefer=self._preferred
@@ -254,6 +283,33 @@ class AbdClient:
             return reply, coordinator, challenge
         finally:
             self._pending.pop(challenge, None)
+
+    def _maybe_fast_fail(self, blocked: tuple, deadline: Optional[Deadline],
+                         op: str) -> None:
+        """Bulwark fast-fail: when EVERY trusted coordinator's breaker is
+        refusing traffic and the nearest half-open probe lies beyond the
+        caller's remaining budget, no attempt in this request can succeed
+        — each would time out against a target the breaker already ruled
+        out, and the budget cannot outlive the earliest probe. Degrade NOW
+        with the typed error (microseconds) instead of burning the
+        Deadline. While any probe still fits the budget the degraded try
+        proceeds exactly as before."""
+        if not self.cfg.fast_fail_all_open or deadline is None:
+            return
+        trusted = self.replicas.get_trusted()
+        if not trusted or any(n not in blocked for n in trusted):
+            return
+        eta = min(self.breakers[n].half_open_eta() for n in trusted)
+        if eta < deadline.remaining():
+            return
+        metrics.inc(
+            "dds_fast_fail_total", **self._mlabels(op=op),
+            help="requests degraded instantly: all coordinator breakers "
+                 "open past the remaining budget",
+        )
+        tracer.event("abd.fast_fail", op=op, eta=round(eta, 4),
+                     targets=len(trusted))
+        raise AllBreakersOpenError(eta, len(trusted))
 
     async def fetch_set(self, key: str, deadline: Optional[Deadline] = None):
         """Quorum read; returns the stored set (list) or None."""
@@ -458,6 +514,13 @@ class AbdClient:
             )
         if fingerprint is not None and cached_tags is None:
             raise ValueError("fingerprint requires cached_tags")
+        # the broadcast needs quorum_size replies, so a fabric whose every
+        # coordinator breaker is open past the budget is as futile here as
+        # for a point op — same fast-fail
+        self._maybe_fast_fail(
+            tuple(n for n, b in self.breakers.items() if not b.allow()),
+            deadline, "read_tags",
+        )
         timeout = self._attempt_timeout(deadline)
         nonce = sigs.generate_nonce()
         if digest is None:

@@ -30,12 +30,18 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import logging
+import math
 import ssl
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from dds_tpu.core.errors import ByzantineError, WrongShardError
+from dds_tpu.core.admission import AdaptiveCoalescer, AdmissionController
+from dds_tpu.core.errors import (
+    AllBreakersOpenError,
+    ByzantineError,
+    WrongShardError,
+)
 from dds_tpu.core.quorum_client import AbdClient
 from dds_tpu.http import json_protocol as J
 from dds_tpu.http.miniserver import HttpServer, Request, Response, http_request
@@ -72,6 +78,12 @@ _REQ_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
 # then lands on the new group. Never a silent misroute.
 _RETRYABLE = (ByzantineError, WrongShardError, asyncio.TimeoutError,
               NoTrustedNodesError, OSError)
+
+# Observability/control routes stay admission-exempt: operators must be
+# able to see WHY the system is shedding while it sheds, so /health,
+# /metrics, /slo, /shards (and the debug-gated /_trace) bypass the
+# Bulwark gate entirely and keep answering through a full shed.
+_ADMISSION_EXEMPT = frozenset({"health", "metrics", "slo", "shards", "_trace"})
 
 
 @dataclass
@@ -175,6 +187,13 @@ class ProxyConfig:
     analytics_enabled: bool = True
     analytics_max_rows: int = 256
     analytics_max_request_bytes: int = 1 << 20
+    # Bulwark admission control (core/admission): an AdmissionConfig-shaped
+    # object (utils/config.AdmissionConfig, or any duck-typed twin) with
+    # enabled=True arms per-tenant/per-class token buckets and the
+    # SLO-burn shedding ratchet at the edge — rejections answer 429/503 in
+    # microseconds, BEFORE a Deadline is minted. None/disabled = the
+    # pre-Bulwark behavior (every request admitted).
+    admission: object = None
     # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
     replica_refresh_interval: float = 5.0
     supervisor: Optional[str] = None
@@ -256,6 +275,24 @@ class DDSRestServer:
         else:
             self.prism = None
         self._column_memo: tuple | None = None  # pairs identity -> columns
+        # Bulwark (core/admission): the admission gate + shed ratchet, fed
+        # by the SLO engine's burn alerts and the storage layer's breaker
+        # census; and the adaptive coalescing window sized from observed
+        # fold arrivals. Both None when admission is off — every gate
+        # below is a cheap is-None check.
+        acfg = self.cfg.admission
+        self.admission: AdmissionController | None = None
+        self._coalescer: AdaptiveCoalescer | None = None
+        if acfg is not None and getattr(acfg, "enabled", False):
+            self.admission = AdmissionController.from_config(
+                acfg, alerts=self.slo.alerts, breakers=self._breaker_census,
+            )
+            if getattr(acfg, "adaptive_coalesce", True) and self.cfg.coalesce_window > 0:
+                self._coalescer = AdaptiveCoalescer(
+                    base_window=self.cfg.coalesce_window,
+                    max_window=getattr(acfg, "coalesce_max_window", 0.02),
+                    target_folds=getattr(acfg, "coalesce_target_folds", 8.0),
+                )
 
     # ------------------------------------------------------------ lifecycle
 
@@ -270,6 +307,8 @@ class DDSRestServer:
             if self.abd.cfg.supervisor is None:
                 self.abd.cfg.supervisor = self.cfg.supervisor  # pin ActiveReplicas source
             self._tasks.append(asyncio.ensure_future(self._replica_refresh_loop()))
+        if self.admission is not None:
+            self._tasks.append(asyncio.ensure_future(self._admission_loop()))
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -696,8 +735,67 @@ class DDSRestServer:
 
     # -------------------------------------------------------------- routing
 
+    def _breaker_census(self) -> tuple[int, list[float]]:
+        """(trusted coordinator count, refusing-breaker half-open ETAs)
+        from whatever storage client is behind this proxy; a client
+        without the surface (test stubs) reads as healthy."""
+        census = getattr(self.abd, "breaker_census", None)
+        return census() if census is not None else (0, [])
+
+    def _derive_retry_after(self, *candidates: float | None) -> int:
+        """Satellite of ISSUE 7: Retry-After derived from actual recovery
+        state — the nearest breaker half-open probe plus any
+        caller-supplied candidate (token-bucket refill ETA, fast-fail
+        ETA) — instead of the static config constant, which only remains
+        as the fallback when nothing measurable is pending."""
+        vals = [c for c in candidates if c is not None and 0 < c < math.inf]
+        _, etas = self._breaker_census()
+        vals.extend(e for e in etas if e > 0)
+        eta = min(vals) if vals else self.cfg.retry_after_hint
+        return max(1, math.ceil(eta))
+
+    def _admission_reject(self, d, route: str, method: str) -> Response:
+        """Format one Bulwark rejection: 429 (per-tenant throttle) or 503
+        (shed). No Deadline was minted and no storage work ran — the
+        request fails in microseconds with an honest Retry-After."""
+        if d.status == 429:
+            retry_after = max(1, math.ceil(d.retry_after)) \
+                if 0 < d.retry_after < math.inf \
+                else max(1, math.ceil(self.cfg.retry_after_hint))
+        else:
+            retry_after = self._derive_retry_after(d.retry_after)
+        metrics.inc(
+            "dds_http_requests_total", route=route or "root",
+            method=method, status=str(d.status),
+            help="REST requests by route and status",
+        )
+        # shed 503s burn the route's SLO budget (they are ours); throttle
+        # 429s are the tenant's own rate and do not
+        self.slo.observe(route or "root", d.status, 0.0)
+        return Response(
+            d.status,
+            f"admission rejected ({d.reason})".encode(),
+            headers={"Retry-After": str(retry_after)},
+        )
+
+    async def _admission_loop(self) -> None:
+        """Controller heartbeat: decide() ticks the ratchet lazily under
+        traffic, but recovery (un-shedding) must also happen when the
+        shed class is the ONLY traffic — this timer guarantees
+        evaluations keep flowing either way."""
+        interval = max(0.05, self.admission.eval_interval)
+        while True:
+            await asyncio.sleep(interval)
+            self.admission.evaluate()
+
     async def handle(self, req: Request) -> Response:
         route = req.path.split("/", 2)[1] if "/" in req.path else req.path
+        if self.admission is not None and route not in _ADMISSION_EXEMPT:
+            decision = self.admission.decide(
+                route, req.headers.get(self.admission.tenant_header, "default")
+            )
+            if not decision.admitted:
+                return self._admission_reject(decision, route, req.method)
         # Trace root minted at the edge (or stitched under an upstream
         # caller's x-dds-trace header): every span recorded below — quorum
         # rounds, replica handlers scheduled over the transport, kernel
@@ -718,17 +816,20 @@ class DDSRestServer:
         except (ValueError, KeyError, TypeError) as e:
             status = 400
             return Response.text(f"bad request: {e}", 400)
-        except (DeadlineExceededError, NoTrustedNodesError) as e:
+        except (DeadlineExceededError, NoTrustedNodesError,
+                AllBreakersOpenError) as e:
             # graceful degradation: the quorum is unreachable within the
             # budget — tell the client WHEN to come back instead of hanging
-            # or aborting opaquely
+            # or aborting opaquely. AllBreakersOpenError is the fast-fail
+            # variant: it arrives in microseconds with the probe ETA.
             status = 503
             log.warning("degraded %s %s: %s", req.method, req.path, e)
-            kind = (
-                "deadline_exceeded"
-                if isinstance(e, DeadlineExceededError)
-                else "no_trusted_nodes"
-            )
+            if isinstance(e, DeadlineExceededError):
+                kind = "deadline_exceeded"
+            elif isinstance(e, AllBreakersOpenError):
+                kind = "all_breakers_open"
+            else:
+                kind = "no_trusted_nodes"
             metrics.inc(
                 "dds_degraded_total", route=route or "root", kind=kind,
                 help="requests degraded to 503 (budget exhausted / no quorum)",
@@ -738,7 +839,7 @@ class DDSRestServer:
                 kind, trace_id=ctx.trace_id, route=route or "root",
                 method=req.method, error=str(e),
             )
-            return self._unavailable(str(e))
+            return self._unavailable(str(e), getattr(e, "eta", None))
         except Exception:
             log.exception("route failure %s %s", req.method, req.path)
             return Response(500)
@@ -757,13 +858,11 @@ class DDSRestServer:
             )
             self.slo.observe(route or "root", status, dur)
 
-    def _unavailable(self, why: str) -> Response:
-        import math
-
+    def _unavailable(self, why: str, eta: float | None = None) -> Response:
         return Response(
             503,
             f"service unavailable: {why}".encode(),
-            headers={"Retry-After": str(max(1, math.ceil(self.cfg.retry_after_hint)))},
+            headers={"Retry-After": str(self._derive_retry_after(eta))},
         )
 
     async def _route(self, req: Request) -> Response:
@@ -982,9 +1081,7 @@ class DDSRestServer:
                     health["recovery"] = recovery
                 resp = Response.json(health, status=503 if degraded else 200)
                 if degraded:
-                    resp.headers["Retry-After"] = str(
-                        max(1, round(self.cfg.retry_after_hint))
-                    )
+                    resp.headers["Retry-After"] = str(self._derive_retry_after())
                 return resp
 
             case ("GET", "metrics") if self.cfg.metrics_route_enabled:
@@ -1009,10 +1106,13 @@ class DDSRestServer:
             case ("GET", "slo") if self.cfg.slo_route_enabled:
                 # per-route objective/burn state (obs/slo) plus the
                 # Watchtower audit summary — the automated-verdict
-                # surface: what is burning budget, what invariants broke
-                return Response.json(
-                    {"slo": self.slo.report(), "audit": watchtower.stats()}
-                )
+                # surface: what is burning budget, what invariants broke,
+                # and (when Bulwark is armed) what admission is doing
+                # about it
+                body = {"slo": self.slo.report(), "audit": watchtower.stats()}
+                if self.admission is not None:
+                    body["admission"] = self.admission.report()
+                return Response.json(body)
 
             case ("GET", "_trace") if self.cfg.trace_route_enabled:
                 # live observability (SURVEY §5.5): per-span timing summary
@@ -1072,6 +1172,21 @@ class DDSRestServer:
                     "dds_shard_keys", n, shard=gid,
                     help="stored aggregate keys per shard (proxy view)",
                 )
+        # Bulwark admission surface: shed level is set at transition time
+        # too, but a scrape between transitions still deserves the truth;
+        # the coalescing window is pure scrape-time state
+        if self.admission is not None:
+            metrics.set(
+                "dds_admission_shed_level", self.admission.shed_level,
+                help="Bulwark shed level (0=none; higher sheds lower "
+                     "priority classes first)",
+            )
+        if self._coalescer is not None:
+            metrics.set(
+                "dds_admission_coalesce_window_seconds",
+                self._coalescer.window(),
+                help="current adaptive fold-coalescing window",
+            )
         # SLO burn/budget gauges + audit backlog (scrape-time freshness is
         # all a gauge promises; the violation COUNTER increments at
         # detection time in the auditor itself)
@@ -1318,6 +1433,11 @@ class DDSRestServer:
         be = self.backend
         fold = self._backend_fold_fn()
         min_batch = getattr(be, "min_device_batch", 0)
+        if self._coalescer is not None:
+            # Bulwark adaptive coalescing: every fold arrival feeds the
+            # rate estimate the window is sized from, whichever path it
+            # takes below
+            self._coalescer.note_fold(len(operands))
         concurrent = self._folds_inflight > 0 or bool(self._fold_pending)
         if (
             self.cfg.coalesce_window <= 0
@@ -1337,8 +1457,16 @@ class DDSRestServer:
             self._fold_drainer = asyncio.ensure_future(self._drain_folds())
         return await fut
 
+    def _coalesce_window(self) -> float:
+        """The gather window for this drain cycle: adaptive (sized from
+        observed fold arrival rate) when Bulwark armed it, else the
+        config constant."""
+        if self._coalescer is not None:
+            return self._coalescer.window()
+        return self.cfg.coalesce_window
+
     async def _drain_folds(self) -> None:
-        await asyncio.sleep(self.cfg.coalesce_window)
+        await asyncio.sleep(self._coalesce_window())
         while self._fold_pending:
             # snapshot ALL pending groups and dispatch them concurrently:
             # different moduli must overlap their dispatches (the whole

@@ -178,6 +178,30 @@ class SloEngine:
             }
         return out
 
+    def alerts(self) -> list[str]:
+        """Routes whose multiwindow burn alert is CURRENTLY firing (both
+        windows burning >= burn_alert). The page signal as a cheap list —
+        the Bulwark admission controller polls this every evaluation tick,
+        so it skips report()'s full per-window dict construction."""
+        with self._lock:
+            items = [(r, list(b)) for r, b in self._bins.items()]
+        out = []
+        for route, bins in items:
+            slo = self.slo_for(route)
+            budget = max(1e-9, 1.0 - slo.objective)
+            firing = True
+            for w in self.windows:
+                good, bad_lat, bad_err = self._window_counts(bins, w)
+                total = good + bad_lat + bad_err
+                bad = bad_lat + bad_err
+                burn = (bad / total) / budget if total else 0.0
+                if burn < self.burn_alert:
+                    firing = False
+                    break
+            if firing:
+                out.append(route)
+        return out
+
     def export_gauges(self, registry) -> None:
         """Mirror the report as scrape-time gauges (http/server calls this
         from `_sample_state_gauges`)."""

@@ -363,6 +363,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             quorum_size=cfg.replicas.byz_quorum_size,
             breaker_threshold=cfg.proxy.breaker_threshold,
             breaker_reset=cfg.proxy.breaker_reset,
+            fast_fail_all_open=cfg.admission.fast_fail,
         ),
     )
     server = DDSRestServer(
@@ -390,6 +391,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             analytics_enabled=cfg.analytics.enabled,
             analytics_max_rows=cfg.analytics.max_rows,
             analytics_max_request_bytes=cfg.analytics.max_request_bytes,
+            admission=cfg.admission,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),
@@ -512,6 +514,7 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
         quorum_size=sh.quorum_size,
         breaker_threshold=cfg.proxy.breaker_threshold,
         breaker_reset=cfg.proxy.breaker_reset,
+        fast_fail_all_open=cfg.admission.fast_fail,
     )
     const = build_constellation(
         net,
@@ -566,6 +569,7 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
             analytics_enabled=cfg.analytics.enabled,
             analytics_max_rows=cfg.analytics.max_rows,
             analytics_max_request_bytes=cfg.analytics.max_request_bytes,
+            admission=cfg.admission,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),

@@ -241,6 +241,25 @@ class ShardRouter:
             out.update(c.breaker_states())
         return out
 
+    def breaker_census(self) -> tuple[int, list[float]]:
+        """Fleet-wide (trusted coordinator count, refusing-breaker ETAs)
+        for the Bulwark controller. Per-group fast-fail needs no router
+        code: each delegated AbdClient raises AllBreakersOpenError for ITS
+        group when all of that group's coordinators are open past the
+        budget — a single dead group degrades its own keys immediately
+        without shedding the healthy groups."""
+        total, etas = 0, []
+        for c in self.clients.values():
+            n, e = c.breaker_census()
+            total += n
+            etas.extend(e)
+        return total, etas
+
+    def min_half_open_eta(self) -> float | None:
+        _, etas = self.breaker_census()
+        positive = [e for e in etas if e > 0]
+        return min(positive) if positive else None
+
     def refresh_from(self, supervisor: str | None = None) -> None:
         """Refresh every group from ITS OWN supervisor (pinned on each
         client's config at build time); the argument — the single

@@ -288,6 +288,59 @@ class AnalyticsConfig:
 
 
 @dataclass
+class AdmissionConfig:
+    """Bulwark overload control (dds_tpu/core/admission): per-tenant/
+    per-priority-class token buckets and SLO-burn-driven load shedding at
+    the REST edge, decided BEFORE a Deadline is minted — rejected
+    requests answer 429/503 in microseconds with a Retry-After derived
+    from actual refill/breaker state. Priority classes: `interactive`
+    (point ops) > `aggregate` (folds/search/analytics) > `background`
+    (gossip, unclassified); the shedding ratchet drops the lowest class
+    first and recovers one level per `shed-hold` clean evaluations.
+    DEPLOY.md "Overload control (Bulwark)" is the runbook."""
+
+    enabled: bool = False
+    # tenant attribution header; absent header = the "default" tenant
+    tenant_header: str = "x-dds-tenant"
+    # per-tenant token buckets, one per priority class: `rate` sustained
+    # requests/s refilling up to `burst` capacity. Sized so a single
+    # well-behaved tenant never notices them; the point is that ONE hot
+    # tenant exhausts its own bucket, not the fleet's Deadline budgets.
+    interactive_rate: float = 400.0
+    interactive_burst: float = 800.0
+    aggregate_rate: float = 64.0
+    aggregate_burst: float = 128.0
+    background_rate: float = 16.0
+    background_burst: float = 32.0
+    # route name -> class name overrides (e.g. { "SearchEq" = "background" })
+    classes: dict = field(default_factory=dict)
+    # shedding controller: evaluated every eval-interval seconds (and
+    # lazily under traffic); distress = any SERVED class's multiwindow SLO
+    # burn alert firing, or >= breaker-shed-fraction of trusted
+    # coordinators refusing traffic. Recovery steps down ONE level after
+    # shed-hold consecutive clean evaluations (hysteresis).
+    eval_interval: float = 1.0
+    shed_hold: int = 3
+    # 1 sheds background, 2 also aggregates, 3 also interactive (a full
+    # shed: only the exempt /health /metrics /slo /shards keep answering).
+    # Default stops at 2 — interactive traffic is never shed unless an
+    # operator explicitly allows it.
+    max_shed_level: int = 2
+    breaker_shed_fraction: float = 0.5
+    # storage-layer fast-fail (AbdClient): when ALL of a group's
+    # coordinators have open breakers and none will half-open within the
+    # remaining budget, degrade instantly instead of burning the Deadline
+    fast_fail: bool = True
+    # adaptive fold coalescing: size proxy.coalesce-window from the
+    # observed fold arrival rate — stretch toward coalesce-max-window
+    # until ~coalesce-target-folds arrivals share a dispatch under load,
+    # snap back to the base window when idle
+    adaptive_coalesce: bool = True
+    coalesce_max_window: float = 0.02
+    coalesce_target_folds: float = 8.0
+
+
+@dataclass
 class AttackConfig:
     enabled: bool = False
     # crash | byzantine | partition | delay | flood | heal (the network
@@ -312,6 +365,7 @@ class DDSConfig:
     obs: ObsConfig = field(default_factory=ObsConfig)
     shard: ShardConfig = field(default_factory=ShardConfig)
     analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     debug: bool = False
 
     # ------------------------------------------------------------- loading
@@ -339,7 +393,10 @@ class DDSConfig:
     def load(path: str | pathlib.Path) -> "DDSConfig":
         p = pathlib.Path(path)
         if p.suffix == ".toml":
-            import tomllib
+            try:
+                import tomllib
+            except ModuleNotFoundError:  # py<3.11: tomli is API-identical
+                import tomli as tomllib
 
             data = tomllib.loads(p.read_text())
         else:
@@ -358,5 +415,6 @@ _SUBSECTIONS = {
     ("DDSConfig", "obs"): ObsConfig,
     ("DDSConfig", "shard"): ShardConfig,
     ("DDSConfig", "analytics"): AnalyticsConfig,
+    ("DDSConfig", "admission"): AdmissionConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
 }

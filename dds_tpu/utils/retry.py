@@ -229,6 +229,16 @@ class CircuitBreaker:
         self._maybe_half_open()
         return self._state != self.OPEN
 
+    def half_open_eta(self) -> float:
+        """Seconds until this breaker's next half-open probe (0 when it is
+        not refusing traffic). The honest Retry-After for a degraded
+        response: clients coming back any sooner are guaranteed to find
+        the same open breaker."""
+        self._maybe_half_open()
+        if self._state != self.OPEN:
+            return 0.0
+        return max(0.0, self.reset_timeout - (self._clock() - self._opened_at))
+
     def record_success(self) -> None:
         self._transition(self.CLOSED)
         self._failures = 0
