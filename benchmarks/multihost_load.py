@@ -1,0 +1,247 @@
+"""Open-loop Zipf load against a REAL multi-process Meridian fleet.
+
+    python -m benchmarks.multihost_load [--rates 50,150] [--duration 2]
+
+Spawns an S=2 constellation as separate OS processes on loopback TCP —
+one process per quorum group (role "group:N") plus a separate proxy
+(role "proxy") — waits for the proxy to report healthy, then drives the
+fleet with `dds_tpu.fabric.loadgen`'s coordinated-omission-safe
+open-loop generator across an arrival-rate sweep and reports p50/p95/p99
+(measured from scheduled arrival instants) plus the SLO engine's burn
+view. One `multihost load` record lands via `benchmarks.common.emit`;
+`sentry.py --check` validates its shape.
+
+`vs_baseline` = good completions / offered arrivals at the top rate —
+1.0 means the fleet absorbed the whole open-loop offered load.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FRAME_SECRET = "meridian-bench-frames"
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _toml(role: str, t_port: int, ports: dict, *, proxy_port: int = 0,
+          status_port: int = 0, keys: int = 0) -> str:
+    groups = "\n".join(
+        f'{gid} = "127.0.0.1:{p}"' for gid, p in sorted(ports["groups"].items())
+    )
+    bootstrap = ", ".join(f'"127.0.0.1:{p}"' for p in ports["status"])
+    return f"""
+[shard]
+enabled = true
+count = 2
+replicas-per-group = 4
+sentinent-per-group = 1
+quorum-size = 3
+
+[transport]
+kind = "tcp"
+host = "127.0.0.1"
+port = {t_port}
+
+[security]
+transport-frame-secret = "{FRAME_SECRET}"
+
+[recovery]
+enabled = false
+anti-entropy-enabled = false
+
+[proxy]
+host = "127.0.0.1"
+port = {proxy_port}
+
+[client]
+nr-of-operations = {keys}
+
+[obs]
+audit-enabled = false
+
+[fabric]
+role = "{role}"
+bootstrap = [{bootstrap}]
+status-port = {status_port}
+gossip-wait = 5.0
+admin-routes = true
+
+[fabric.groups]
+{groups}
+"""
+
+
+class Fleet:
+    """An S=2 loopback fleet as real OS processes: group s0, group s1,
+    (optionally standby groups), and one proxy. Reused by the flagship
+    multihost test, which adds a standby group and drives a live split."""
+
+    def __init__(self, workdir: str, *, standby: int = 0,
+                 proxy_count: int = 1):
+        self.dir = pathlib.Path(workdir)
+        gids = ["s0", "s1"] + [f"s{2 + i}" for i in range(standby)]
+        self.ports = {
+            "groups": {gid: free_port() for gid in gids},
+            "status": [free_port() for _ in gids],
+            "proxy": [free_port() for _ in range(proxy_count)],
+        }
+        self.gids = gids
+        self.procs: dict[str, subprocess.Popen] = {}
+
+    def config_path(self, name: str) -> pathlib.Path:
+        return self.dir / f"{name}.toml"
+
+    def _write_configs(self) -> None:
+        for i, gid in enumerate(self.gids):
+            self.config_path(gid).write_text(_toml(
+                f"group:{gid[1:]}", self.ports["groups"][gid], self.ports,
+                status_port=self.ports["status"][i],
+            ))
+        for i, port in enumerate(self.ports["proxy"]):
+            self.config_path(f"proxy{i}").write_text(_toml(
+                "proxy", free_port(), self.ports, proxy_port=port,
+            ))
+
+    def spawn(self, name: str) -> subprocess.Popen:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = open(self.dir / f"{name}.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dds_tpu.run",
+             "--config", str(self.config_path(name)), "--serve"],
+            cwd=REPO, env=env, stdout=out, stderr=subprocess.STDOUT,
+        )
+        self.procs[name] = proc
+        return proc
+
+    def start(self) -> None:
+        self._write_configs()
+        for gid in self.gids:
+            self.spawn(gid)
+        for i in range(len(self.ports["proxy"])):
+            self.spawn(f"proxy{i}")
+
+    @property
+    def proxy_targets(self) -> list[str]:
+        return [f"127.0.0.1:{p}" for p in self.ports["proxy"]]
+
+    async def wait_healthy(self, timeout: float = 90.0) -> None:
+        """Poll every proxy's /health until all groups hold quorum."""
+        from dds_tpu.http.miniserver import http_request
+
+        deadline = time.monotonic() + timeout
+        for port in self.ports["proxy"]:
+            while True:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"fleet not healthy within {timeout}s "
+                        f"(see logs under {self.dir})"
+                    )
+                for name, proc in self.procs.items():
+                    if proc.poll() is not None:
+                        raise RuntimeError(
+                            f"fleet process {name} exited rc={proc.returncode} "
+                            f"(see {self.dir / (name + '.log')})"
+                        )
+                try:
+                    status, body = await http_request(
+                        "127.0.0.1", port, "GET", "/health", timeout=2.0)
+                    if status == 200 and json.loads(body)["status"] == "ok":
+                        break
+                except (OSError, asyncio.TimeoutError, ValueError,
+                        EOFError, ConnectionError):
+                    pass
+                await asyncio.sleep(0.25)
+
+    def stop(self) -> None:
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+        self.procs.clear()
+
+
+async def _drive(fleet: Fleet, rates: list[float], duration: float,
+                 keys: int, zipf_s: float, seed: int):
+    from dds_tpu.fabric.loadgen import OpenLoopLoad
+
+    load = OpenLoopLoad(fleet.proxy_targets, keys=keys, zipf_s=zipf_s,
+                        seed=seed, timeout=5.0)
+    await load.seed()
+    return await load.sweep(rates, duration)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rates", default="50,150",
+                    help="comma-separated open-loop arrival rates (req/s)")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="seconds per rate point")
+    ap.add_argument("--keys", type=int, default=48)
+    ap.add_argument("--zipf", type=float, default=1.1)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+
+    from benchmarks.common import emit
+
+    rows = []
+    with tempfile.TemporaryDirectory(prefix="meridian-bench-") as workdir:
+        fleet = Fleet(workdir)
+        try:
+            fleet.start()
+            asyncio.run(fleet.wait_healthy())
+            reports = asyncio.run(_drive(
+                fleet, rates, args.duration, args.keys, args.zipf, args.seed
+            ))
+        finally:
+            fleet.stop()
+
+    top = reports[-1]
+    offered = max(1, top.scheduled)
+    rows.append(emit(
+        "multihost load",
+        top.achieved_rps,
+        "req/s",
+        top.good / offered,
+        rates=rates,
+        duration=args.duration,
+        processes=len(fleet.gids) + len(fleet.ports["proxy"]),
+        open_loop=True,
+        zipf_s=args.zipf,
+        keys=args.keys,
+        p50_ms=round(top.p50_ms, 3),
+        p95_ms=round(top.p95_ms, 3),
+        p99_ms=round(top.p99_ms, 3),
+        per_class=top.per_class,
+        slo_alerts=top.slo.get("alerts", []),
+        sweep=[r.to_dict() | {"slo": None} for r in reports],
+    ))
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -19,8 +19,9 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from benchmarks import (analytics_matvec, audit_cost, bft_sum, crossover,
-                            encrypt_modexp, mixed, overload_goodput, product,
-                            put_concurrency, shard_scaling, sweep)
+                            encrypt_modexp, mixed, multihost_load,
+                            overload_goodput, product, put_concurrency,
+                            shard_scaling, sweep)
 
     rows = []
     if args.quick:
@@ -38,6 +39,9 @@ def main(argv=None):
             ["--duration", "1.5", "--keys", "32", "--bits", "1024",
              "--interactive-rate", "15", "--aggregate-rate", "120"]
         )
+        rows += multihost_load.main(
+            ["--rates", "40,100", "--duration", "1.5", "--keys", "24"]
+        )
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -50,6 +54,7 @@ def main(argv=None):
         rows += shard_scaling.main([])
         rows += analytics_matvec.main([])
         rows += overload_goodput.main([])
+        rows += multihost_load.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

@@ -7,10 +7,12 @@ Modes (all emit one JSON line to stdout):
         no jax import) — the CPU-only smoke CI runs so a corrupted
         baseline is caught before it silently disables gating.
         Also parses any `shard scaling` (benchmarks/shard_scaling.py),
-        `analytics matvec` (benchmarks/analytics_matvec.py) and
-        `overload goodput` (benchmarks/overload_goodput.py) records in
+        `analytics matvec` (benchmarks/analytics_matvec.py),
+        `overload goodput` (benchmarks/overload_goodput.py) and
+        `multihost load` (benchmarks/multihost_load.py) records in
         benchmarks/results.json / results_quick.json so a malformed
-        scaling, analytics or overload record is caught by the same smoke.
+        scaling, analytics, overload or multihost record is caught by
+        the same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -179,6 +181,44 @@ def _check_overload_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_multihost_records(root: str = REPO) -> dict:
+    """Validate `multihost load` rows (benchmarks/multihost_load.py):
+    positive good-req/s value, a detail block naming the swept rates, the
+    OS-process count (>= 2, or it measured nothing multi-process), the
+    open-loop flag, and ordered non-negative p50<=p95<=p99 latencies
+    measured from scheduled arrivals. Same malformed contract as the
+    other row families: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("multihost load")):
+            continue
+        detail = row.get("detail")
+        pcts = []
+        if isinstance(detail, dict):
+            pcts = [detail.get(k) for k in ("p50_ms", "p95_ms", "p99_ms")]
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("rates"), list)
+            and len(detail["rates"]) >= 1
+            and all(isinstance(r, (int, float)) and r > 0
+                    for r in detail["rates"])
+            and isinstance(detail.get("processes"), int)
+            and detail["processes"] >= 2
+            and detail.get("open_loop") is True
+            and all(isinstance(p, (int, float)) and p >= 0 for p in pcts)
+            and pcts[0] <= pcts[1] <= pcts[2]
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed multihost-load record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _load_fresh(path: str) -> dict:
     """A stats JSON: either the baseline schema or a bare kernels dict."""
     with open(path) as f:
@@ -221,6 +261,7 @@ def main(argv=None) -> int:
             shard = _check_shard_records()
             analytics = _check_analytics_records()
             overload = _check_overload_records()
+            multihost = _check_multihost_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -231,6 +272,7 @@ def main(argv=None) -> int:
             "shard_scaling_rows": shard["rows"],
             "analytics_rows": analytics["rows"],
             "overload_rows": overload["rows"],
+            "multihost_rows": multihost["rows"],
         }))
         return 0
 

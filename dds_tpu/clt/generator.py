@@ -15,13 +15,18 @@ SearchEntryOR uses its own count (reference reuses search-entry's, `:253`).
 from __future__ import annotations
 
 import random
-import string
 from typing import Iterable
 
 from dds_tpu.clt import instructions as I
 
-# column type vocabulary, as in DDSDataGenerator.ALLOWED_DATA_TYPES
-ALLOWED_DATA_TYPES = ("String", "Char", "Int", "Long", "Float", "Double", "Boolean", "Blob")
+# value/row distributions live in clt/distribution so the open-loop load
+# plane (fabric/loadgen) drives the SAME data shapes this closed-loop
+# generator does; re-exported here for compatibility
+from dds_tpu.clt.distribution import (  # noqa: F401  (re-exports)
+    ALLOWED_DATA_TYPES,
+    generate_column_data,
+    random_row,
+)
 
 DEFAULT_PROPORTIONS = {
     "get-set": 0.0, "put-set": 0.1, "remove-set": 0.0, "add-element": 0.0,
@@ -31,29 +36,6 @@ DEFAULT_PROPORTIONS = {
     "search-lt": 0.1, "search-lteq": 0.1, "order-ls": 0.0, "order-sl": 0.0,
     "search-entry": 0.1, "search-entry-and": 0.1, "search-entry-or": 0.1,
 }
-
-
-def generate_column_data(ctype: str, rng: random.Random):
-    """Random typed value for one column (`DDSDataGenerator.scala:271-282`)."""
-    match ctype:
-        case "Int":
-            return rng.randrange(0, 1 << 16)
-        case "Long":
-            return rng.randrange(0, 1 << 31)
-        case "Float" | "Double":
-            # encrypted columns carry ints; floats only appear in the tail
-            return round(rng.uniform(0, 1e6), 3)
-        case "Char":
-            return rng.choice(string.ascii_letters)
-        case "Boolean":
-            return rng.choice([True, False])
-        case "Blob":
-            return "".join(rng.choices(string.ascii_letters + string.digits, k=32))
-        case _:
-            return " ".join(
-                "".join(rng.choices(string.ascii_lowercase, k=rng.randrange(3, 9)))
-                for _ in range(rng.randrange(1, 4))
-            )
 
 
 def _columns_by_scheme(schema: list[str]) -> dict[str, list[int]]:
@@ -91,10 +73,7 @@ def generate(
         return round(nr_of_operations * props.get(op, 0.0))
 
     def rand_row() -> list:
-        row = [generate_column_data(mappings[i], rng) for i in range(fixed)]
-        for _ in range(rng.randrange(0, max(1, max_nr_of_columns - fixed + 1))):
-            row.append(generate_column_data(rng.choice(ALLOWED_DATA_TYPES), rng))
-        return row
+        return random_row(mappings[:fixed], max_nr_of_columns, rng)
 
     def pick(scheme_cols: Iterable[str]) -> list[int]:
         merged: list[int] = []

@@ -121,6 +121,17 @@ class ChaosNet(Transport):
     def has_endpoint(self, addr):
         return self.inner.has_endpoint(addr)
 
+    @property
+    def advertised(self) -> str:
+        """Inner transport's peer-visible "host:port" (TcpNet); empty for
+        fabrics without one — Meridian derives endpoint namers through the
+        chaos wrap."""
+        return getattr(self.inner, "advertised", "")
+
+    def local_addr(self, name: str) -> str:
+        fn = getattr(self.inner, "local_addr", None)
+        return fn(name) if fn is not None else name
+
     # ------------------------------------------------------------- schedule
 
     def set_link(self, src: str, dest: str, faults: LinkFaults) -> None:

@@ -405,6 +405,80 @@ class ShardMigrateAck:
 
 
 # --------------------------------------------------------------------------
+# Meridian multi-host fabric control plane (dds_tpu/fabric)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ShardMapInstall:
+    """Controller -> group fabric agent: install `map` (ShardMap wire
+    dict) into the group's FENCING state — the cross-host freeze step of
+    a live reshard. The map is HMAC-signed with the intranet secret and
+    re-verified by the receiving agent, so the frame only has to be
+    delivered, not trusted; `force` permits the abort path's epoch
+    rollback. Rides the authenticated transport like the Kill/Redeploy
+    control messages."""
+
+    map: dict
+    force: bool
+    nonce: int
+
+
+@dataclass(frozen=True)
+class ShardMapActivate:
+    """Controller -> group fabric agent: adopt `map` as the ACTIVE
+    routing map this process serves at GET /shards (and fences under,
+    epoch-forward). Broadcast to every group after a reshard activates so
+    remote long-pollers see the bump at their next gossip wake."""
+
+    map: dict
+    nonce: int
+
+
+@dataclass(frozen=True)
+class ShardMapAck:
+    """Agent -> controller: install/activate outcome. `epoch` is the
+    agent's fencing epoch after the attempt; ok=False carries the reason
+    (bad signature, backwards epoch) so the rebalancer can abort."""
+
+    nonce: int
+    epoch: int
+    ok: bool
+    error: str = ""
+
+
+@dataclass(frozen=True)
+class ShardExportRequest:
+    """Controller -> agent: export replica `endpoint`'s repository as
+    migration seed DATA (one ShardExport frame; receivers re-verify every
+    entry against the attested manifest quorum, so this is bandwidth, not
+    trust). Bounded by TcpNet.MAX_FRAME — shard/rebalance chunks the
+    verified subset before streaming it to the target group."""
+
+    endpoint: str
+    nonce: int
+
+
+@dataclass(frozen=True)
+class ShardExport:
+    nonce: int
+    entries: dict
+
+
+@dataclass(frozen=True)
+class ShardPruneRequest:
+    """Controller -> agent: drop repository entries the group no longer
+    owns under its CURRENT fencing map (post-activation cleanup)."""
+
+    nonce: int
+
+
+@dataclass(frozen=True)
+class ShardPruned:
+    nonce: int
+    dropped: int
+
+
+# --------------------------------------------------------------------------
 # fault injection backdoor (malicious/MaliciousAttack.scala:34)
 # --------------------------------------------------------------------------
 
@@ -439,6 +513,8 @@ _TYPES = {
         MerkleRootRequest, MerkleRoot, MerkleBucketRequest, MerkleBuckets,
         MerkleKeysRequest, MerkleKeys, RepairRequest, RepairReply,
         WrongShard, ShardMigrateBegin, ShardMigrateAck,
+        ShardMapInstall, ShardMapActivate, ShardMapAck,
+        ShardExportRequest, ShardExport, ShardPruneRequest, ShardPruned,
     )
 }
 

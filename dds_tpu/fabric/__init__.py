@@ -1,0 +1,41 @@
+"""Meridian: the multi-host shard fabric.
+
+Takes the Constellation sharding plane (dds_tpu/shard) across process
+and host boundaries: per-group `TcpNet` deployment driven by a `[fabric]`
+config role, signed shard-map distribution via `GET /shards` bootstrap +
+epoch-gossip long-polls (304 when fresh, a push the moment an epoch
+bumps), cross-host live resharding through per-group control agents, and
+an open-loop load plane (`fabric.loadgen`) that drives the fleet like a
+million impatient users and reports through the SLO engine. DEPLOY.md
+"Multi-host (Meridian)" is the runbook.
+"""
+
+from dds_tpu.fabric.deploy import (
+    FabricStatusServer,
+    MeridianController,
+    group_endpoints,
+    initial_map,
+    launch_meridian,
+    parse_role,
+)
+from dds_tpu.fabric.gossip import (
+    EpochGossipHub,
+    MapFollower,
+    RemoteShardManager,
+    bootstrap_map,
+    fetch_shards,
+)
+from dds_tpu.fabric.remote import (
+    AgentClient,
+    AgentError,
+    MeridianAgent,
+    RemoteShardGroup,
+)
+
+__all__ = [
+    "FabricStatusServer", "MeridianController", "group_endpoints",
+    "initial_map", "launch_meridian", "parse_role",
+    "EpochGossipHub", "MapFollower", "RemoteShardManager",
+    "bootstrap_map", "fetch_shards",
+    "AgentClient", "AgentError", "MeridianAgent", "RemoteShardGroup",
+]

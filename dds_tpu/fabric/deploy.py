@@ -1,0 +1,572 @@
+"""Meridian role deployment: one TOML, N OS processes, one constellation.
+
+`run.launch` lands here when `shard.enabled` meets `transport.kind =
+"tcp"`. The `[fabric]` section names every group's transport address and
+this process's `role`; the three roles compose a fleet:
+
+- **all** — the full constellation (S groups + ShardRouter + REST proxy)
+  in one process over real sockets: the single-box production posture
+  and the bring-up smoke for the multi-process one.
+- **group:N** — quorum group sN only: replicas + spares + supervisor +
+  anti-entropy + Trudy over this process's `TcpNet`, a `MeridianAgent`
+  control endpoint (`<host:port>/sN-fabric`) for cross-host freezes/
+  activations/exports/prunes, and a status listener serving the signed
+  map at `GET /shards` (with 304/long-poll gossip) so proxies can
+  bootstrap from any surviving group.
+- **proxy** — the REST proxy + ShardRouter only: bootstraps the signed
+  map from `fabric.bootstrap` peers, keeps it fresh with epoch-gossip
+  long-polls, derives every group's replica addresses from the shared
+  config, and hosts the `MeridianController` that drives cross-host
+  `Rebalancer.split`s (exposed at `POST /_reshard` with `admin-routes`).
+
+Every process derives the SAME epoch-1 map (`ShardMap.build` is
+deterministic over the group list) and verifies every later map against
+the shared intranet secret, so fleet bring-up has no ordering
+constraints: a proxy started before its groups serves 503s until quorums
+appear, never wrong answers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import types
+
+from dds_tpu.fabric.gossip import (
+    EpochGossipHub,
+    MapFollower,
+    RemoteShardManager,
+    bootstrap_map,
+)
+from dds_tpu.fabric.remote import AgentClient, MeridianAgent, RemoteShardGroup
+from dds_tpu.http.miniserver import HttpServer, Request, Response
+from dds_tpu.http.server import DDSRestServer
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.obs.slo import SloEngine
+from dds_tpu.shard.fabric import build_constellation, build_group
+from dds_tpu.shard.rebalance import Rebalancer
+from dds_tpu.shard.router import ShardRouter
+from dds_tpu.shard.shardmap import ShardManager, ShardMap, ShardState
+
+log = logging.getLogger("dds.fabric")
+
+
+def parse_role(role: str) -> tuple[str, str | None]:
+    """("all"|"proxy"|"group", gid|None) from a [fabric] role string.
+    Accepts "group:2" (-> "s2") and "group:s2"."""
+    role = (role or "all").strip()
+    if role in ("all", "proxy"):
+        return role, None
+    kind, sep, which = role.partition(":")
+    if kind == "group" and sep:
+        which = which.strip()
+        if which.isdigit():
+            return "group", f"s{int(which)}"
+        if which:
+            return "group", which
+    raise ValueError(
+        f"unknown fabric role {role!r} (expected 'all', 'proxy', or "
+        f"'group:<N>')"
+    )
+
+
+def initial_map(cfg) -> ShardMap:
+    """The deterministic epoch-1 map every process derives from [shard]."""
+    gids = [f"s{i}" for i in range(cfg.shard.count)]
+    return ShardMap.build(gids, cfg.shard.vnodes_per_group).sign(
+        cfg.security.abd_mac_secret.encode()
+    )
+
+
+def group_endpoints(cfg, gid: str) -> tuple[list[str], list[str]]:
+    """(active, sentinent) full replica addresses for `gid`, derived from
+    fabric.groups + the homogeneous [shard] geometry — identical in every
+    process of the fleet."""
+    hostport = cfg.fabric.groups.get(gid)
+    if not hostport:
+        raise ValueError(
+            f"group {gid!r} has no [fabric.groups] transport address"
+        )
+    n_act, n_sen = cfg.shard.replicas_per_group, cfg.shard.sentinent_per_group
+    active = [f"{hostport}/{gid}-replica-{i}" for i in range(n_act)]
+    sentinent = [
+        f"{hostport}/{gid}-replica-{i}" for i in range(n_act, n_act + n_sen)
+    ]
+    return active, sentinent
+
+
+def _groups_body(cfg, smap: ShardMap) -> dict:
+    out = {}
+    for gid in smap.groups:
+        try:
+            active, _ = group_endpoints(cfg, gid)
+        except ValueError:
+            log.warning("group %s missing from [fabric.groups]", gid)
+            continue
+        out[gid] = active
+    return out
+
+
+class _Stopper:
+    """Adapter: any callable (sync or async) as a Deployment stoppable."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    async def stop(self):
+        res = self._fn()
+        if asyncio.iscoroutine(res):
+            await res
+
+
+class FabricStatusServer:
+    """The group-role status listener: GET /shards (signed map, ETag/304
+    + long-poll gossip), /health, /metrics — enough surface for proxies
+    to bootstrap from and operators to watch, without a storage router
+    in the process."""
+
+    def __init__(self, host: str, port: int, view, groups_fn, hub,
+                 *, group=None, gid: str = "", wait_cap: float = 60.0,
+                 ssl_context=None):
+        self.view = view
+        self.groups_fn = groups_fn
+        self.hub = hub
+        self.group = group
+        self.gid = gid
+        self.wait_cap = wait_cap
+        self._http = HttpServer(host, port, self.handle, ssl_context)
+        self.cfg = types.SimpleNamespace(host=host, port=port)
+
+    async def start(self) -> None:
+        await self._http.start()
+        self.cfg.port = self._http.port
+
+    async def stop(self) -> None:
+        await self._http.stop()
+
+    def status(self) -> dict:
+        return {
+            "state": self.view.state,
+            "map": self.view.current().to_wire(),
+            "groups": self.groups_fn(),
+        }
+
+    async def handle(self, req: Request) -> Response:
+        if req.method != "GET":
+            return Response(405)
+        route = req.path.strip("/")
+        if route == "shards":
+            etag = req.headers.get("if-none-match", "").strip().strip('"')
+            if etag and etag == str(self.view.epoch):
+                try:
+                    wait = float(req.query.get("wait", 0) or 0)
+                except ValueError:
+                    wait = 0.0
+                if wait > 0 and self.hub is not None:
+                    await self.hub.wait_change(min(wait, self.wait_cap))
+                if etag == str(self.view.epoch):
+                    return Response(
+                        304, headers={"ETag": f'"{self.view.epoch}"'}
+                    )
+            resp = Response.json(self.status())
+            resp.headers["ETag"] = f'"{self.view.epoch}"'
+            return resp
+        if route == "health":
+            body = {
+                "status": "ok",
+                "role": "group",
+                "group": self.gid,
+                "shard_epoch": self.view.epoch,
+                "reshard_state": self.view.state,
+            }
+            if self.group is not None:
+                body["fence_epoch"] = self.group.state.epoch
+                body["replicas"] = {
+                    n.name: len(n.repository)
+                    for n in self.group.replicas.values()
+                }
+            return Response.json(body)
+        if route == "metrics":
+            return Response(
+                200, metrics.render().encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+        return Response(404)
+
+
+class MeridianController:
+    """Cross-host reshard driver, hosted in a proxy (or all-role)
+    process: wraps the Rebalancer with RemoteShardGroup handles derived
+    from the fabric config and broadcasts every activation to the fleet's
+    group agents so remote /shards views and long-pollers see the epoch
+    bump immediately."""
+
+    def __init__(self, cfg, net, namer, manager, rpc: AgentClient):
+        self.cfg = cfg
+        self.fab = cfg.fabric
+        self.sh = cfg.shard
+        self.manager = manager
+        self.rpc = rpc
+        self.rebalancer = Rebalancer(
+            manager, net, cfg.security.abd_mac_secret.encode(),
+            addr=namer("rebalancer"),
+            manifest_timeout=self.sh.manifest_timeout,
+            ack_timeout=self.sh.ack_timeout,
+            chunk_keys=self.sh.migrate_chunk_keys,
+            on_activate=self.broadcast_activation,
+        )
+
+    def handle_for(self, gid: str) -> RemoteShardGroup:
+        hostport = self.fab.groups.get(gid)
+        if not hostport:
+            raise ValueError(
+                f"group {gid!r} has no [fabric.groups] transport address"
+            )
+        return RemoteShardGroup(
+            gid, hostport,
+            n_active=self.sh.replicas_per_group,
+            n_sentinent=self.sh.sentinent_per_group,
+            quorum=self.sh.quorum_size,
+            rpc=self.rpc,
+        )
+
+    def pick_target(self, smap: ShardMap) -> str:
+        """First configured standby group not yet in the map."""
+        for gid in sorted(self.fab.groups):
+            if gid not in smap.groups:
+                return gid
+        raise ValueError(
+            "no standby group in [fabric.groups] to split into"
+        )
+
+    async def split(self, source: str, target: str | None = None) -> ShardMap:
+        smap = self.manager.current()
+        if source not in smap.groups:
+            raise ValueError(f"unknown source group {source!r}")
+        target = target or self.pick_target(smap)
+        if target in smap.groups:
+            raise ValueError(f"target group {target!r} already in the map")
+        return await self.rebalancer.split(
+            self.handle_for(source), self.handle_for(target)
+        )
+
+    async def broadcast_activation(self, smap: ShardMap) -> None:
+        """Push the activated map to every configured group agent (the
+        split participants already fence under it; the others adopt it
+        epoch-forward and wake their /shards long-pollers). Best effort:
+        an unreachable agent catches up from gossip or its next
+        bootstrap — fencing guarantees hold regardless."""
+
+        async def one(gid: str, hostport: str) -> None:
+            try:
+                await self.rpc.activate(f"{hostport}/{gid}-fabric", smap)
+            except Exception as e:
+                log.warning("activation push to %s failed: %s", gid, e)
+
+        await asyncio.gather(
+            *(one(g, hp) for g, hp in sorted(self.fab.groups.items()))
+        )
+
+
+def _namer(net):
+    """Full-address namer through an optional ChaosNet wrap."""
+    fn = getattr(net, "local_addr", None)
+    if fn is None:
+        raise ValueError("meridian roles need a TcpNet-backed transport")
+    return fn
+
+
+def _attach_watchtower(cfg, *, check_quorum: bool, geometry: dict) -> None:
+    if not cfg.obs.audit_enabled:
+        return
+    from dds_tpu.obs.watchtower import watchtower
+    from dds_tpu.utils.trace import tracer as _tracer
+
+    watchtower.configure(
+        quorum_size=cfg.shard.quorum_size,
+        n_replicas=cfg.shard.replicas_per_group,
+        check_quorum=cfg.obs.audit_quorum_checks and check_quorum,
+        group_geometry=geometry,
+    )
+    watchtower.attach(_tracer)
+
+
+async def launch_meridian(cfg, net, stoppables, ssl_server, ssl_client):
+    kind, gid = parse_role(cfg.fabric.role)
+    if kind == "all":
+        return await _launch_all(cfg, net, stoppables, ssl_server, ssl_client)
+    if kind == "group":
+        return await _launch_group(cfg, net, stoppables, ssl_server,
+                                   ssl_client, gid)
+    return await _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client)
+
+
+# --------------------------------------------------------------- role: all
+
+
+async def _launch_all(cfg, net, stoppables, ssl_server, ssl_client):
+    """The whole constellation in this process, over real sockets."""
+    from dds_tpu.run import Deployment, proxy_config, shard_configs
+
+    sh = cfg.shard
+    rcfg, sup_cfg, abd_cfg = shard_configs(cfg)
+    namer = _namer(net)
+    const = build_constellation(
+        net,
+        shard_count=sh.count,
+        vnodes_per_group=sh.vnodes_per_group,
+        secret=cfg.security.abd_mac_secret.encode(),
+        manifest_timeout=sh.manifest_timeout,
+        ack_timeout=sh.ack_timeout,
+        chunk_keys=sh.migrate_chunk_keys,
+        namer=namer,
+        n_active=sh.replicas_per_group,
+        n_sentinent=sh.sentinent_per_group,
+        quorum=sh.quorum_size,
+        max_faults=sh.max_faults,
+        rcfg=rcfg,
+        sup_cfg=sup_cfg,
+        abd_cfg=abd_cfg,
+        chaos=cfg.attacks.chaos_enabled,
+    )
+    replicas = {}
+    for g in const.groups:
+        replicas.update(g.replicas)
+    if cfg.recovery.enabled:
+        for g in const.groups:
+            g.supervisor.start()
+    if cfg.recovery.anti_entropy_enabled:
+        for node in replicas.values():
+            node.antientropy.configure(
+                interval=cfg.recovery.anti_entropy_interval,
+                jitter=cfg.recovery.anti_entropy_jitter,
+            )
+            node.antientropy.start()
+
+        class _AES:
+            async def stop(self):
+                for node in replicas.values():
+                    await node.antientropy.stop()
+
+        stoppables.append(_AES())
+
+    # epoch gossip: remote proxies long-poll this process's /shards; every
+    # in-process activation (Constellation.split / the admin route) wakes
+    # them through the rebalancer's on_activate hook
+    hub = EpochGossipHub()
+    const.rebalancer.on_activate = lambda smap: hub.notify()
+
+    async def reshard(source: str, target: str | None = None):
+        if target is not None:
+            raise ValueError(
+                "role 'all' builds its split target in-process; "
+                "omit 'target'"
+            )
+        await const.split(source)
+        return const.manager.current()
+
+    server = DDSRestServer(
+        const.router,
+        proxy_config(
+            cfg, const.groups[0].supervisor.addr, ssl_server, ssl_client,
+            reshard_route_enabled=cfg.fabric.admin_routes,
+        ),
+        local_replicas=replicas,
+        slo=SloEngine.from_obs(cfg.obs),
+        gossip=hub,
+        reshard=reshard,
+    )
+    await server.start()
+
+    dep = Deployment(cfg, net, replicas, None, server,
+                     const.groups[0].trudy, ssl_client, stoppables,
+                     constellation=const)
+    # every replica's handler spans land in THIS process's tracer ring, so
+    # the quorum-intersection audit stays sound even over sockets
+    _attach_watchtower(
+        cfg, check_quorum=True,
+        geometry={g.gid: (g.quorum_size, len(g.active))
+                  for g in const.groups},
+    )
+    return dep
+
+
+# ------------------------------------------------------------- role: group
+
+
+async def _launch_group(cfg, net, stoppables, ssl_server, ssl_client,
+                        gid: str):
+    """One quorum group + fabric agent + status listener."""
+    from dds_tpu.run import Deployment, shard_configs
+
+    sh, fab = cfg.shard, cfg.fabric
+    secret = cfg.security.abd_mac_secret.encode()
+    rcfg, sup_cfg, abd_cfg = shard_configs(cfg)
+    namer = _namer(net)
+    if gid not in fab.groups:
+        raise ValueError(
+            f"this process's group {gid!r} is missing from [fabric.groups]"
+        )
+
+    # freshest map available: deterministic epoch-1 from config, upgraded
+    # from any reachable peer so a RESTARTED group process re-fences under
+    # the fleet's current epoch instead of a stale one
+    smap = initial_map(cfg)
+    own_status = f"{fab.status_host or cfg.transport.host}:{fab.status_port}"
+    peers = [p for p in fab.bootstrap if p != own_status]
+    newer, _ = await bootstrap_map(
+        peers, secret, timeout=fab.bootstrap_timeout, ssl_context=ssl_client
+    )
+    if newer is not None and newer.epoch > smap.epoch:
+        smap = newer
+
+    state = ShardState(gid, smap, secret)
+    group = build_group(
+        net, gid, state,
+        n_active=sh.replicas_per_group,
+        n_sentinent=sh.sentinent_per_group,
+        quorum=sh.quorum_size,
+        max_faults=sh.max_faults,
+        rcfg=rcfg, sup_cfg=sup_cfg, abd_cfg=abd_cfg,
+        chaos=cfg.attacks.chaos_enabled,
+        namer=namer,
+    )
+    if cfg.recovery.enabled:
+        group.supervisor.start()
+    if cfg.recovery.anti_entropy_enabled:
+        for node in group.replicas.values():
+            node.antientropy.configure(
+                interval=cfg.recovery.anti_entropy_interval,
+                jitter=cfg.recovery.anti_entropy_jitter,
+            )
+            node.antientropy.start()
+    stoppables.append(_Stopper(group.stop))
+
+    hub = EpochGossipHub()
+    view = RemoteShardManager(smap, secret, hub=hub)
+    agent = MeridianAgent(net, namer(f"{gid}-fabric"), group, view, secret,
+                          hub=hub)
+    stoppables.append(_Stopper(agent.stop))
+
+    # stay fresh when the activation push misses us (partition during a
+    # reshard we weren't part of): long-poll the other peers' /shards
+    follower = MapFollower(
+        view, peers, secret, wait=fab.gossip_wait,
+        ssl_context=ssl_client, install_also=[state],
+    )
+    follower.start()
+    stoppables.append(_Stopper(follower.stop))
+
+    server = FabricStatusServer(
+        fab.status_host or cfg.transport.host, fab.status_port,
+        view, lambda: _groups_body(cfg, view.current()), hub,
+        group=group, gid=gid, ssl_context=ssl_server,
+    )
+    await server.start()
+
+    dep = Deployment(cfg, net, dict(group.replicas), None, server,
+                     group.trudy, ssl_client, stoppables)
+    # replica spans are local but the coordinators live elsewhere, so the
+    # quorum-intersection checks would see every commit as quorumless
+    _attach_watchtower(
+        cfg, check_quorum=False,
+        geometry={gid: (sh.quorum_size, sh.replicas_per_group)},
+    )
+    return dep
+
+
+# ------------------------------------------------------------- role: proxy
+
+
+async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
+    """REST proxy + ShardRouter over remote groups, with map bootstrap,
+    epoch-gossip freshness, and the cross-host reshard controller."""
+    from dds_tpu.core.quorum_client import AbdClient
+    from dds_tpu.run import Deployment, proxy_config, shard_configs
+
+    sh, fab = cfg.shard, cfg.fabric
+    secret = cfg.security.abd_mac_secret.encode()
+    _, _, abd_cfg = shard_configs(cfg)
+    namer = _namer(net)
+
+    smap = initial_map(cfg)
+    boot, body = await bootstrap_map(
+        fab.bootstrap, secret, timeout=fab.bootstrap_timeout,
+        ssl_context=ssl_client,
+    )
+    state_flag = None
+    if boot is not None and boot.epoch >= smap.epoch:
+        smap = boot
+        state_flag = (body or {}).get("state")
+
+    hub = EpochGossipHub()
+
+    def make_client(cgid: str) -> AbdClient:
+        active, _ = group_endpoints(cfg, cgid)
+        hostport = cfg.fabric.groups[cgid]
+        c = AbdClient(
+            namer(f"{cgid}-proxy"), net, active,
+            dataclasses.replace(
+                abd_cfg, shard=cgid,
+                supervisor=f"{hostport}/{cgid}-supervisor",
+            ),
+        )
+        return c
+
+    def on_install(new_map: ShardMap, old_map: ShardMap) -> None:
+        # a split-born group enters the map: grow a client for it from
+        # the fabric config (mirrors Constellation.split's wiring)
+        for new_gid in new_map.groups:
+            if new_gid in router.clients or new_gid not in fab.groups:
+                continue
+            c = make_client(new_gid)
+            c.shard_epoch = lambda m=manager: m.current().epoch
+            router.clients[new_gid] = c
+            log.info("grew a client for new group %s", new_gid)
+
+    manager = RemoteShardManager(smap, secret, hub=hub, on_install=on_install)
+    if state_flag:
+        manager.install(smap, state=state_flag)
+    follower = MapFollower(
+        manager, fab.bootstrap, secret, wait=fab.gossip_wait,
+        ssl_context=ssl_client,
+    )
+    clients = {g: make_client(g) for g in smap.groups if g in fab.groups}
+    if not clients:
+        raise ValueError(
+            "no routable groups: [fabric.groups] must map every group id "
+            "in the shard map to its transport host:port"
+        )
+    router = ShardRouter(manager, clients, refresh=follower.poke)
+    follower.start()
+    stoppables.append(_Stopper(follower.stop))
+
+    rpc = AgentClient(net, namer("meridian-ctl"), timeout=fab.rpc_timeout)
+    stoppables.append(_Stopper(rpc.stop))
+    controller = MeridianController(cfg, net, namer, manager, rpc)
+
+    sup0 = next(iter(clients.values())).cfg.supervisor
+    server = DDSRestServer(
+        router,
+        proxy_config(
+            cfg, sup0, ssl_server, ssl_client,
+            reshard_route_enabled=fab.admin_routes,
+        ),
+        local_replicas={},
+        slo=SloEngine.from_obs(cfg.obs),
+        gossip=hub,
+        reshard=controller.split,
+    )
+    await server.start()
+
+    dep = Deployment(cfg, net, {}, None, server, None, ssl_client,
+                     stoppables)
+    # no replica handler spans in this process: tag/repair/state-machine
+    # audits stay on, quorum-intersection ones can't be sound here
+    _attach_watchtower(
+        cfg, check_quorum=False,
+        geometry={g: (sh.quorum_size, sh.replicas_per_group)
+                  for g in smap.groups},
+    )
+    return dep

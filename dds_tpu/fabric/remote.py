@@ -1,0 +1,219 @@
+"""Meridian cross-host reshard plumbing: agents and remote group handles.
+
+`shard/rebalance.Rebalancer` was written against the in-process
+`ShardGroup` handle: freeze = a synchronous `state.install`, the seed
+export a direct repository read, the post-activation prune a method call.
+Across hosts those three become control-plane RPCs; everything ELSE the
+rebalancer does (manifest collection, chunk streaming, ack quorums)
+already rides plain transport messages and needs no change.
+
+- `MeridianAgent` runs in every group process, registered at
+  `<host:port>/<gid>-fabric`. It installs signed maps into the group's
+  shared fencing state (freeze / rollback), adopts activations into the
+  process's serving view (waking `/shards` long-polls), exports a
+  replica's repository as migration seed data, and prunes after cut-over.
+- `AgentClient` + `RemoteShardGroup` live in the controller (proxy)
+  process and present the exact `ShardGroup` surface the Rebalancer
+  expects — `state.install` / `export_from` / `prune_unowned` return
+  awaitables, which the rebalancer now awaits when it gets one.
+
+Trust: the map is HMAC-signed and re-verified at the agent, so install/
+activate frames only need delivery. Export returns DATA (every receiving
+replica re-verifies entries against the attested manifest quorum), and
+prune only drops keys the group's OWN fencing map disowns. The frames
+ride the authenticated transport (frame MAC / nodeauth / intranet TLS),
+the same trust the Kill/Redeploy control messages already ride.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dds_tpu.core import messages as M
+from dds_tpu.shard.shardmap import ShardMap
+from dds_tpu.utils import sigs
+
+log = logging.getLogger("dds.fabric.remote")
+
+
+class MeridianAgent:
+    """Per-group-process control endpoint for the fabric RPCs."""
+
+    def __init__(self, net, addr: str, group, view, secret: bytes,
+                 hub=None):
+        self.net = net
+        self.addr = addr
+        self.group = group          # shard.fabric.ShardGroup (local)
+        self.view = view            # RemoteShardManager serving-view mirror
+        self.secret = secret
+        self.hub = hub
+        net.register(addr, self.handle)
+
+    def stop(self) -> None:
+        self.net.unregister(self.addr)
+
+    def _ack(self, dest: str, nonce: int, ok: bool, error: str = "") -> None:
+        self.net.send(self.addr, dest,
+                      M.ShardMapAck(nonce, self.group.state.epoch, ok, error))
+
+    async def handle(self, sender: str, msg) -> None:
+        if isinstance(msg, M.ShardMapInstall):
+            try:
+                smap = ShardMap.from_wire(msg.map)
+                self.group.state.install(smap, force=msg.force)
+            except (ValueError, KeyError, TypeError) as e:
+                log.warning("refused shard-map install from %s: %s",
+                            sender, e)
+                self._ack(sender, msg.nonce, False, str(e))
+                return
+            self._ack(sender, msg.nonce, True)
+        elif isinstance(msg, M.ShardMapActivate):
+            try:
+                smap = ShardMap.from_wire(msg.map)
+                self.view.install(smap)          # verifies + notifies hub
+                # fencing follows the active map epoch-forward; during a
+                # split the participants already hold it from the freeze
+                if smap.epoch > self.group.state.epoch:
+                    self.group.state.install(smap)
+            except (ValueError, KeyError, TypeError) as e:
+                log.warning("refused shard-map activate from %s: %s",
+                            sender, e)
+                self._ack(sender, msg.nonce, False, str(e))
+                return
+            self._ack(sender, msg.nonce, True)
+        elif isinstance(msg, M.ShardExportRequest):
+            entries = self.group.export_from(msg.endpoint)
+            self.net.send(self.addr, sender, M.ShardExport(msg.nonce, entries))
+        elif isinstance(msg, M.ShardPruneRequest):
+            dropped = self.group.prune_unowned()
+            self.net.send(self.addr, sender, M.ShardPruned(msg.nonce, dropped))
+
+
+class AgentError(RuntimeError):
+    """An agent refused an RPC (bad map, backwards epoch) or timed out —
+    the rebalancer's generic failure path aborts the split safely."""
+
+
+class AgentClient:
+    """Controller-side RPC endpoint: correlates nonced requests to agent
+    replies with a timeout. One instance serves every remote group."""
+
+    def __init__(self, net, addr: str, timeout: float = 5.0):
+        self.net = net
+        self.addr = addr
+        self.timeout = timeout
+        self._pending: dict[int, asyncio.Future] = {}
+        net.register(addr, self.handle)
+
+    def stop(self) -> None:
+        self.net.unregister(self.addr)
+
+    async def handle(self, sender: str, msg) -> None:
+        nonce = getattr(msg, "nonce", None)
+        fut = self._pending.get(nonce)
+        if fut is not None and not fut.done():
+            fut.set_result(msg)
+
+    async def _call(self, agent: str, make_msg, *, timeout: float | None = None):
+        nonce = sigs.generate_nonce()
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[nonce] = fut
+        try:
+            self.net.send(self.addr, agent, make_msg(nonce))
+            return await asyncio.wait_for(fut, timeout or self.timeout)
+        except asyncio.TimeoutError:
+            raise AgentError(f"agent {agent} did not answer")
+        finally:
+            self._pending.pop(nonce, None)
+
+    async def install(self, agent: str, smap: ShardMap,
+                      force: bool = False) -> None:
+        wire = smap.to_wire()
+        reply = await self._call(
+            agent, lambda n: M.ShardMapInstall(wire, force, n)
+        )
+        if not isinstance(reply, M.ShardMapAck) or not reply.ok:
+            raise AgentError(
+                f"agent {agent} refused map install: "
+                f"{getattr(reply, 'error', 'bad reply')!r}"
+            )
+
+    async def activate(self, agent: str, smap: ShardMap) -> None:
+        wire = smap.to_wire()
+        reply = await self._call(agent, lambda n: M.ShardMapActivate(wire, n))
+        if not isinstance(reply, M.ShardMapAck) or not reply.ok:
+            raise AgentError(
+                f"agent {agent} refused map activate: "
+                f"{getattr(reply, 'error', 'bad reply')!r}"
+            )
+
+    async def export(self, agent: str, endpoint: str,
+                     timeout: float | None = None) -> dict:
+        reply = await self._call(
+            agent, lambda n: M.ShardExportRequest(endpoint, n),
+            timeout=timeout,
+        )
+        if not isinstance(reply, M.ShardExport):
+            raise AgentError(f"agent {agent} sent a bad export reply")
+        return dict(reply.entries)
+
+    async def prune(self, agent: str) -> int:
+        reply = await self._call(agent, lambda n: M.ShardPruneRequest(n))
+        if not isinstance(reply, M.ShardPruned):
+            raise AgentError(f"agent {agent} sent a bad prune reply")
+        return int(reply.dropped)
+
+
+class _RemoteGroupState:
+    """`ShardState`-shaped fencing handle whose `install` returns an
+    awaitable resolving when the remote agent acked (shard/rebalance
+    awaits whatever `install` returns)."""
+
+    def __init__(self, rpc: AgentClient, agent: str):
+        self._rpc = rpc
+        self._agent = agent
+
+    def install(self, smap: ShardMap, force: bool = False):
+        return self._rpc.install(self._agent, smap, force=force)
+
+
+class RemoteShardGroup:
+    """The rebalancer-facing handle for a group hosted in ANOTHER
+    process: same attribute surface as `shard.fabric.ShardGroup`, with
+    the three state-touching calls returning awaitables over the agent
+    RPCs. Replica/supervisor addresses are derived from the fabric
+    config's per-group host:port and the homogeneous shard geometry —
+    the same derivation every process in the fleet applies."""
+
+    def __init__(self, gid: str, hostport: str, *, n_active: int,
+                 n_sentinent: int, quorum: int, rpc: AgentClient,
+                 export_timeout: float = 10.0):
+        self.gid = gid
+        self.hostport = hostport
+        self.active = [
+            f"{hostport}/{gid}-replica-{i}" for i in range(n_active)
+        ]
+        self.sentinent = [
+            f"{hostport}/{gid}-replica-{i}"
+            for i in range(n_active, n_active + n_sentinent)
+        ]
+        self.quorum_size = quorum
+        self.agent = f"{hostport}/{gid}-fabric"
+        self.state = _RemoteGroupState(rpc, self.agent)
+        self._rpc = rpc
+        self._export_timeout = export_timeout
+
+    def all_replicas(self) -> list[str]:
+        return self.active + self.sentinent
+
+    def export_from(self, endpoint: str):
+        if endpoint is None:
+            async def _empty():
+                return {}
+            return _empty()
+        return self._rpc.export(self.agent, endpoint,
+                                timeout=self._export_timeout)
+
+    def prune_unowned(self):
+        return self._rpc.prune(self.agent)

@@ -48,7 +48,8 @@ class Response:
 
 
 _REASONS = {
-    200: "OK", 204: "No Content", 400: "Bad Request", 404: "Not Found",
+    200: "OK", 204: "No Content", 304: "Not Modified",
+    400: "Bad Request", 404: "Not Found",
     405: "Method Not Allowed", 413: "Payload Too Large",
     429: "Too Many Requests",
     500: "Internal Server Error", 503: "Service Unavailable",
@@ -164,10 +165,12 @@ async def http_request(
     content_type: str = "application/json",
     ssl_context=None,
     timeout: float = 30.0,
+    headers: dict[str, str] | None = None,
 ) -> tuple[int, bytes]:
     """One-shot HTTP client request; returns (status, body)."""
     status, _, data = await http_request_full(
-        host, port, method, target, body, content_type, ssl_context, timeout
+        host, port, method, target, body, content_type, ssl_context, timeout,
+        headers,
     )
     return status, data
 
@@ -181,19 +184,26 @@ async def http_request_full(
     content_type: str = "application/json",
     ssl_context=None,
     timeout: float = 30.0,
+    headers: dict[str, str] | None = None,
 ) -> tuple[int, dict, bytes]:
     """Like `http_request` but also returns the (lower-cased) response
-    headers — callers inspecting Retry-After / degradation metadata."""
+    headers — callers inspecting Retry-After / degradation metadata.
+    `headers` adds request headers (conditional gets, trace context,
+    tenant attribution)."""
 
     async def go():
         reader, writer = await asyncio.open_connection(host, port, ssl=ssl_context)
         try:
             payload = body or b""
+            extra = "".join(
+                f"{k}: {v}\r\n" for k, v in (headers or {}).items()
+            )
             head = (
                 f"{method} {target} HTTP/1.1\r\n"
                 f"Host: {host}:{port}\r\n"
                 f"Content-Type: {content_type}\r\n"
                 f"Content-Length: {len(payload)}\r\n"
+                f"{extra}"
                 f"Connection: close\r\n\r\n"
             )
             writer.write(head.encode() + payload)
@@ -203,18 +213,18 @@ async def http_request_full(
                 status = int(status_line.split()[1])
             except (IndexError, ValueError):
                 raise ConnectionError(f"malformed status line: {status_line!r}")
-            headers: dict[str, str] = {}
+            rheaders: dict[str, str] = {}
             while True:
                 h = await reader.readline()
                 if h in (b"\r\n", b"\n", b""):
                     break
                 name, _, val = h.decode().partition(":")
-                headers[name.strip().lower()] = val.strip()
-            if "content-length" in headers:
-                data = await reader.readexactly(int(headers["content-length"]))
+                rheaders[name.strip().lower()] = val.strip()
+            if "content-length" in rheaders:
+                data = await reader.readexactly(int(rheaders["content-length"]))
             else:
                 data = await reader.read()
-            return status, headers, data
+            return status, rheaders, data
         finally:
             writer.close()
 

@@ -81,9 +81,11 @@ _RETRYABLE = (ByzantineError, WrongShardError, asyncio.TimeoutError,
 
 # Observability/control routes stay admission-exempt: operators must be
 # able to see WHY the system is shedding while it sheds, so /health,
-# /metrics, /slo, /shards (and the debug-gated /_trace) bypass the
-# Bulwark gate entirely and keep answering through a full shed.
-_ADMISSION_EXEMPT = frozenset({"health", "metrics", "slo", "shards", "_trace"})
+# /metrics, /slo, /shards (and the debug-gated /_trace, and the Meridian
+# reshard control route) bypass the Bulwark gate entirely and keep
+# answering through a full shed.
+_ADMISSION_EXEMPT = frozenset({"health", "metrics", "slo", "shards",
+                               "_trace", "_reshard"})
 
 
 @dataclass
@@ -197,6 +199,12 @@ class ProxyConfig:
     # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
     replica_refresh_interval: float = 5.0
     supervisor: Optional[str] = None
+    # Meridian (dds_tpu/fabric): cap on the `wait` a /shards long-poll may
+    # request (If-None-Match + ?wait=N gossip — see the shards route), and
+    # the POST /_reshard operator route gate (enabled on proxies launched
+    # with a fabric controller; drives a cross-host Rebalancer.split)
+    shards_wait_cap: float = 60.0
+    reshard_route_enabled: bool = False
     ssl_server_context: object = None
     ssl_client_context: object = None
 
@@ -213,9 +221,16 @@ async def _cancel_task(task: asyncio.Task) -> None:
 class DDSRestServer:
     def __init__(self, abd: AbdClient, config: ProxyConfig | None = None,
                  local_replicas: dict | None = None,
-                 slo: SloEngine | None = None):
+                 slo: SloEngine | None = None,
+                 gossip=None, reshard=None):
         self.abd = abd
         self.cfg = config or ProxyConfig()
+        # Meridian wiring: `gossip` is an EpochGossipHub parked /shards
+        # long-polls sleep on (None = conditional GETs answer immediately);
+        # `reshard` is the fabric controller's async split(source, target)
+        # hook behind POST /_reshard (gated by reshard_route_enabled)
+        self._gossip = gossip
+        self._reshard = reshard
         # per-route SLO accounting (obs/slo): every request is classified
         # good/bad in handle(); run.launch passes an engine built from the
         # [obs] config, tests get the defaults
@@ -856,7 +871,11 @@ class DDSRestServer:
                 method=req.method, status=str(status),
                 help="REST requests by route and status",
             )
-            self.slo.observe(route or "root", status, dur)
+            if status != 304:
+                # a 304 is a deliberately-parked gossip long-poll (or a
+                # free freshness probe) — its held duration is the design,
+                # not latency badness, so it must not burn SLO budget
+                self.slo.observe(route or "root", status, dur)
 
     def _unavailable(self, why: str, eta: float | None = None) -> Response:
         return Response(
@@ -1097,11 +1116,41 @@ class DDSRestServer:
                 )
 
             case ("GET", "shards") if self._shards is not None:
-                # operator inspection: the ACTIVE signed map (epoch +
-                # HMAC, verifiable against the intranet secret), reshard
-                # state, and per-group membership. Always on when sharded
-                # — like /health it reveals topology, not workload shape.
-                return Response.json(self.abd.status())
+                # operator inspection + Meridian gossip: the ACTIVE signed
+                # map (epoch + HMAC, verifiable against the intranet
+                # secret), reshard state, and per-group membership. Always
+                # on when sharded — like /health it reveals topology, not
+                # workload shape. Conditional freshness: `If-None-Match:
+                # "<epoch>"` answers a near-free 304 when the epoch is
+                # unchanged, and `?wait=N` parks the request on the gossip
+                # hub so remote routers get the next epoch bump as a push
+                # instead of hot-polling (see dds_tpu/fabric/gossip).
+                return await self._shards_route(req)
+
+            case ("POST", "_reshard") if (
+                self.cfg.reshard_route_enabled and self._reshard is not None
+            ):
+                # operator control: drive a live cross-host split through
+                # the fabric controller. Body {"source": gid[, "target":
+                # gid]}; answers the activated epoch, or 409 when the
+                # split aborted safely (old map back in force).
+                body = req.json() or {}
+                source = body.get("source")
+                if not isinstance(source, str) or not source:
+                    return Response.text("missing source group", 400)
+                target = body.get("target")
+                from dds_tpu.shard.rebalance import ReshardAborted
+
+                try:
+                    smap = await self._reshard(source, target)
+                except ReshardAborted as e:
+                    return Response.json(
+                        {"aborted": str(e),
+                         "epoch": self._shards.epoch}, status=409,
+                    )
+                return Response.json(
+                    {"epoch": smap.epoch, "groups": list(smap.groups)}
+                )
 
             case ("GET", "slo") if self.cfg.slo_route_enabled:
                 # per-route objective/burn state (obs/slo) plus the
@@ -1130,6 +1179,27 @@ class DDSRestServer:
                 )
 
         return Response(404)
+
+    async def _shards_route(self, req: Request) -> Response:
+        """GET /shards with conditional-get + long-poll gossip semantics."""
+        etag = req.headers.get("if-none-match", "").strip().strip('"')
+        fresh = etag and etag == str(self._shards.epoch)
+        if fresh:
+            try:
+                wait = float(req.query.get("wait", 0) or 0)
+            except ValueError:
+                wait = 0.0
+            if wait > 0 and self._gossip is not None:
+                await self._gossip.wait_change(
+                    min(wait, self.cfg.shards_wait_cap)
+                )
+            if etag == str(self._shards.epoch):
+                return Response(
+                    304, headers={"ETag": f'"{self._shards.epoch}"'}
+                )
+        resp = Response.json(self.abd.status())
+        resp.headers["ETag"] = f'"{self._shards.epoch}"'
+        return resp
 
     _BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
 

@@ -180,14 +180,30 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
         stoppables.append(net)
 
     if cfg.shard.enabled:
+        if cfg.transport.kind == "tcp":
+            # Meridian (dds_tpu/fabric): the multi-host shard fabric —
+            # per-[fabric]-role this process hosts the whole constellation,
+            # one quorum group, or a remote proxy, over the authenticated
+            # TcpNet, with the signed shard map distributed via
+            # GET /shards bootstrap + epoch gossip
+            from dds_tpu.fabric.deploy import launch_meridian
+
+            try:
+                return await launch_meridian(
+                    cfg, net, stoppables, ssl_server, ssl_client
+                )
+            except Exception:
+                # fail-fast must not leak the bound listener (or a chaos
+                # wrapper's timers) — mirror the nodeauth check above
+                for s in reversed(stoppables):
+                    try:
+                        await s.stop()
+                    except Exception:
+                        pass
+                raise
         # Constellation: S independent quorum groups behind a shard router
-        # (single-process topologies — the shard-map install step is an
+        # over the in-process fabric (the shard-map install step is an
         # in-process config push; see utils/config.ShardConfig)
-        if cfg.transport.kind != "memory":
-            raise ValueError(
-                "shard.enabled requires transport.kind = 'memory' "
-                "(multi-host shard-map distribution is future work)"
-            )
         return await _launch_constellation(
             cfg, net, stoppables, ssl_server, ssl_client
         )
@@ -472,18 +488,11 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     return dep
 
 
-async def _launch_constellation(cfg: DDSConfig, net, stoppables,
-                                ssl_server, ssl_client) -> Deployment:
-    """shard.enabled boot: S quorum groups + ShardRouter behind the proxy.
-
-    Each group mirrors the single-group stack (replicas, spares,
-    supervisor, anti-entropy, Trudy) with namespaced endpoints over the
-    one transport; the REST server talks to the ShardRouter, which routes
-    point ops by the signed epoch-versioned ShardMap and scatter-gathers
-    aggregates. The Watchtower audits every group against ITS OWN quorum
-    geometry via the per-group geometry table."""
-    from dds_tpu.shard import build_constellation
-
+def shard_configs(cfg: DDSConfig):
+    """(ReplicaConfig, SupervisorConfig, AbdClientConfig) for one quorum
+    group of a Constellation — shared by the single-process sharded boot
+    below and the Meridian multi-host roles (dds_tpu/fabric/deploy),
+    which must derive IDENTICAL per-group stacks in every process."""
     sh = cfg.shard
     rcfg = ReplicaConfig(
         quorum_size=sh.quorum_size,
@@ -516,6 +525,54 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
         breaker_reset=cfg.proxy.breaker_reset,
         fast_fail_all_open=cfg.admission.fast_fail,
     )
+    return rcfg, sup_cfg, abd_cfg
+
+
+def proxy_config(cfg: DDSConfig, supervisor, ssl_server, ssl_client,
+                 **overrides) -> ProxyConfig:
+    """The sharded proxy's ProxyConfig from the config tree (no gossip
+    peers baked in — the Meridian roles layer those via `overrides`)."""
+    kw = dict(
+        host=cfg.proxy.host,
+        port=cfg.proxy.port,
+        request_budget=cfg.proxy.request_budget,
+        retry_backoff=cfg.proxy.retry_backoff,
+        retry_max_delay=cfg.proxy.retry_max_delay,
+        retry_attempts=cfg.proxy.retry_attempts,
+        retry_after_hint=cfg.proxy.retry_after_hint,
+        handler_timeout=cfg.proxy.handler_timeout,
+        crypto_backend=cfg.proxy.crypto_backend,
+        keys_path=cfg.proxy.stored_keys_path,
+        coalesce_window=cfg.proxy.coalesce_window,
+        supervisor=supervisor,
+        trace_route_enabled=cfg.debug or cfg.obs.trace_route,
+        metrics_route_enabled=cfg.obs.metrics_route,
+        slo_route_enabled=cfg.obs.slo_route,
+        analytics_enabled=cfg.analytics.enabled,
+        analytics_max_rows=cfg.analytics.max_rows,
+        analytics_max_request_bytes=cfg.analytics.max_request_bytes,
+        admission=cfg.admission,
+        ssl_server_context=ssl_server,
+        ssl_client_context=ssl_client,
+    )
+    kw.update(overrides)
+    return ProxyConfig(**kw)
+
+
+async def _launch_constellation(cfg: DDSConfig, net, stoppables,
+                                ssl_server, ssl_client) -> Deployment:
+    """shard.enabled boot: S quorum groups + ShardRouter behind the proxy.
+
+    Each group mirrors the single-group stack (replicas, spares,
+    supervisor, anti-entropy, Trudy) with namespaced endpoints over the
+    one transport; the REST server talks to the ShardRouter, which routes
+    point ops by the signed epoch-versioned ShardMap and scatter-gathers
+    aggregates. The Watchtower audits every group against ITS OWN quorum
+    geometry via the per-group geometry table."""
+    from dds_tpu.shard import build_constellation
+
+    sh = cfg.shard
+    rcfg, sup_cfg, abd_cfg = shard_configs(cfg)
     const = build_constellation(
         net,
         shard_count=sh.count,
@@ -550,29 +607,8 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
 
     server = DDSRestServer(
         const.router,
-        ProxyConfig(
-            host=cfg.proxy.host,
-            port=cfg.proxy.port,
-            request_budget=cfg.proxy.request_budget,
-            retry_backoff=cfg.proxy.retry_backoff,
-            retry_max_delay=cfg.proxy.retry_max_delay,
-            retry_attempts=cfg.proxy.retry_attempts,
-            retry_after_hint=cfg.proxy.retry_after_hint,
-            handler_timeout=cfg.proxy.handler_timeout,
-            crypto_backend=cfg.proxy.crypto_backend,
-            keys_path=cfg.proxy.stored_keys_path,
-            coalesce_window=cfg.proxy.coalesce_window,
-            supervisor=const.groups[0].supervisor.addr,
-            trace_route_enabled=cfg.debug or cfg.obs.trace_route,
-            metrics_route_enabled=cfg.obs.metrics_route,
-            slo_route_enabled=cfg.obs.slo_route,
-            analytics_enabled=cfg.analytics.enabled,
-            analytics_max_rows=cfg.analytics.max_rows,
-            analytics_max_request_bytes=cfg.analytics.max_request_bytes,
-            admission=cfg.admission,
-            ssl_server_context=ssl_server,
-            ssl_client_context=ssl_client,
-        ),
+        proxy_config(cfg, const.groups[0].supervisor.addr,
+                     ssl_server, ssl_client),
         local_replicas=replicas,
         slo=SloEngine.from_obs(cfg.obs),
     )
@@ -595,6 +631,49 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
         )
         watchtower.attach(_tracer)
     return dep
+
+
+def mint_node_keys(count: int, directory: str = "certs",
+                   hosts: list[str] | None = None,
+                   host: str = "127.0.0.1", base_port: int = 2552) -> str:
+    """Provision per-process transport identities for an N-process fleet:
+    one Ed25519 key file per process (born 0600, existing files reused so
+    re-running never rotates keys under a live fleet) plus the
+    `[security]` TOML stanza wiring the public-key registry — the manual,
+    error-prone step of DEPLOY.md §1 as one command:
+
+        python -m dds_tpu.run --mint-node-keys 3 --mint-dir certs \\
+            --mint-hosts 10.0.0.1:2552,10.0.0.2:2552,10.0.0.3:2552
+
+    Returns (and `main` prints) the stanza; paste it into every process's
+    config and point each process's `node-key-path` at ITS key file."""
+    import pathlib
+
+    from dds_tpu.utils import nodeauth
+
+    if hosts:
+        hostports = [
+            hp if ":" in hp else f"{hp}:{base_port}" for hp in hosts
+        ]
+    else:
+        hostports = [f"{host}:{base_port + i}" for i in range(count)]
+    if count and hosts and len(hostports) != count:
+        raise ValueError(
+            f"--mint-node-keys {count} but {len(hostports)} hosts given"
+        )
+    d = pathlib.Path(directory)
+    lines = ["# Meridian node identities — minted by --mint-node-keys.",
+             "# Per process: set security.node-key-path to ITS OWN file:"]
+    registry = []
+    for i, hp in enumerate(hostports):
+        path = d / f"node_{i}.key"
+        key = nodeauth.load_or_create(path)
+        lines.append(f"#   process {i} ({hp}): node-key-path = {str(path)!r}")
+        registry.append(f'"{hp}" = "{nodeauth.public_hex(key)}"')
+    lines.append("")
+    lines.append("[security.node-public-keys]")
+    lines.extend(registry)
+    return "\n".join(lines) + "\n"
 
 
 def load_provider(cfg: DDSConfig) -> HomoProvider:
@@ -632,9 +711,10 @@ async def run_workload(dep: Deployment, provider: HomoProvider | None = None,
     cfg = dep.cfg
     provider = provider or load_provider(cfg)
     rng = random.Random(seed)
-    dep.trudy._rng = rng  # make --seed reproduce attack victim selection
+    if dep.trudy is not None:
+        dep.trudy._rng = rng  # make --seed reproduce attack victim selection
     dt = cfg.client.data_table
-    if cfg.attacks.enabled:
+    if cfg.attacks.enabled and dep.trudy is not None:
         # fire mid-run like the reference (Main.scala:187-193): the workload
         # below must complete correct quorums against a damaged cluster
         asyncio.get_event_loop().call_later(
@@ -674,9 +754,23 @@ def main(argv=None) -> None:
     ap.add_argument("--port", type=int, help="proxy port (0 = auto)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--serve", action="store_true", help="keep serving after workload")
+    ap.add_argument("--role", help="override [fabric] role (all | proxy | group:N)")
+    ap.add_argument("--mint-node-keys", type=int, metavar="N",
+                    help="provision N per-process Ed25519 node keys + the "
+                         "security.node-public-keys TOML stanza, then exit")
+    ap.add_argument("--mint-dir", default="certs",
+                    help="directory for --mint-node-keys files")
+    ap.add_argument("--mint-hosts", default="",
+                    help="comma-separated host:port per process for "
+                         "--mint-node-keys (default 127.0.0.1:2552+i)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, format="%(name)s %(message)s")
+    if args.mint_node_keys is not None:
+        hosts = [h for h in args.mint_hosts.split(",") if h.strip()]
+        print(mint_node_keys(args.mint_node_keys, args.mint_dir,
+                             hosts or None), end="")
+        return
     cfg = DDSConfig.load(args.config) if args.config else DDSConfig()
     if args.ops is not None:
         cfg.client.nr_of_operations = args.ops
@@ -684,19 +778,35 @@ def main(argv=None) -> None:
         cfg.proxy.crypto_backend = args.backend
     if args.port is not None:
         cfg.proxy.port = args.port
+    if args.role:
+        cfg.fabric.role = args.role
 
     async def go():
         dep = await launch(cfg)
         try:
-            reports = await run_workload(dep, seed=args.seed)
-            for i, r in enumerate(reports):
-                print(
-                    f"client {i}: {r.operations} ops in {r.wall_seconds:.2f}s "
-                    f"-> {r.ops_per_second:.1f} ops/s "
-                    f"({r.succeeded} ok, {r.not_found} miss, {r.failed} failed)"
-                )
+            # group-role fabric processes host replicas, not clients; a
+            # proxy launched without a workload (ops 0) also just serves
+            runs_workload = (
+                dep.trudy is not None and cfg.client.nr_of_operations > 0
+            )
+            if cfg.shard.enabled and cfg.transport.kind == "tcp":
+                from dds_tpu.fabric.deploy import parse_role
+
+                if parse_role(cfg.fabric.role)[0] == "group":
+                    runs_workload = False
+            if runs_workload:
+                reports = await run_workload(dep, seed=args.seed)
+                for i, r in enumerate(reports):
+                    print(
+                        f"client {i}: {r.operations} ops in {r.wall_seconds:.2f}s "
+                        f"-> {r.ops_per_second:.1f} ops/s "
+                        f"({r.succeeded} ok, {r.not_found} miss, {r.failed} failed)"
+                    )
             if args.serve:
-                print(f"serving on {cfg.proxy.host}:{dep.server.cfg.port} (ctrl-c to stop)")
+                print(
+                    f"serving on {dep.server.cfg.host}:{dep.server.cfg.port} "
+                    f"(ctrl-c to stop)", flush=True,
+                )
                 await asyncio.Event().wait()
         finally:
             await dep.stop()

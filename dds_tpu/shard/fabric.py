@@ -109,14 +109,22 @@ def build_group(
     abd_cfg: AbdClientConfig | None = None,
     chaos: bool = False,
     rng: random.Random | None = None,
+    namer=None,
 ) -> ShardGroup:
-    """One namespaced quorum group over `net`, fencing under `state`."""
+    """One namespaced quorum group over `net`, fencing under `state`.
+
+    `namer` maps a bare endpoint name to its transport address — identity
+    for the in-memory fabric, `TcpNet.local_addr` for a Meridian group
+    process so every endpoint is a routable `host:port/name`."""
     import dataclasses as _dc
 
+    namer = namer or (lambda name: name)
     rcfg = rcfg or ReplicaConfig(quorum_size=quorum)
-    endpoints = [f"{gid}-replica-{i}" for i in range(n_active + n_sentinent)]
+    endpoints = [
+        namer(f"{gid}-replica-{i}") for i in range(n_active + n_sentinent)
+    ]
     active, sentinent = endpoints[:n_active], endpoints[n_active:]
-    sup_addr = f"{gid}-supervisor"
+    sup_addr = namer(f"{gid}-supervisor")
     replicas = {
         e: BFTABDNode(e, endpoints, sup_addr, net, rcfg, shard=state)
         for e in endpoints
@@ -135,15 +143,17 @@ def build_group(
         abd_cfg = _dc.replace(abd_cfg)
     abd_cfg.shard = gid
     abd_cfg.supervisor = sup_addr
-    client = AbdClient(f"{gid}-proxy", net, active, abd_cfg)
+    client = AbdClient(namer(f"{gid}-proxy"), net, active, abd_cfg)
     if chaos:
         from dds_tpu.malicious.trudy import Nemesis
 
-        trudy = Nemesis(net, active, max_faults, addr=f"{gid}-trudy", rng=rng)
+        trudy = Nemesis(net, active, max_faults, addr=namer(f"{gid}-trudy"),
+                        rng=rng)
     else:
         from dds_tpu.malicious.trudy import Trudy
 
-        trudy = Trudy(net, active, max_faults, addr=f"{gid}-trudy", rng=rng)
+        trudy = Trudy(net, active, max_faults, addr=namer(f"{gid}-trudy"),
+                      rng=rng)
     return ShardGroup(gid, active, sentinent, replicas, supervisor, client,
                       state, quorum, trudy)
 
@@ -159,6 +169,7 @@ def build_constellation(
     chunk_keys: int = 256,
     prune: bool = True,
     seed: int | None = None,
+    namer=None,
     **group_kwargs,
 ) -> Constellation:
     """S homogeneous groups + manager/router/rebalancer over one fabric."""
@@ -170,12 +181,15 @@ def build_constellation(
     for gid in gids:
         state = ShardState(gid, smap, secret)
         grp_rng = random.Random(rng.getrandbits(64)) if rng else None
-        groups.append(build_group(net, gid, state, rng=grp_rng,
+        groups.append(build_group(net, gid, state, rng=grp_rng, namer=namer,
                                   **group_kwargs))
     router = ShardRouter(manager, {g.gid: g.client for g in groups})
     rebalancer = Rebalancer(
-        manager, net, secret, manifest_timeout=manifest_timeout,
+        manager, net, secret,
+        addr=(namer or (lambda n: n))("rebalancer"),
+        manifest_timeout=manifest_timeout,
         ack_timeout=ack_timeout, chunk_keys=chunk_keys, prune=prune,
     )
     return Constellation(manager, router, groups, rebalancer, net=net,
-                         secret=secret, _build_kwargs=dict(group_kwargs))
+                         secret=secret,
+                         _build_kwargs=dict(group_kwargs, namer=namer))

@@ -37,6 +37,7 @@ write stall on the moving slice.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 
 from dds_tpu.core import messages as M
@@ -53,11 +54,21 @@ class ReshardAborted(RuntimeError):
     """A live split failed safely: the old map is back in force."""
 
 
+async def _maybe_await(value):
+    """Group handles are duck-typed: the in-process `ShardGroup` answers
+    state installs / exports / prunes synchronously, the Meridian
+    `RemoteShardGroup` returns awaitables that resolve on the remote
+    agent's ack. The rebalancer awaits whichever it gets."""
+    if inspect.isawaitable(value):
+        return await value
+    return value
+
+
 class Rebalancer:
     def __init__(self, manager, net, abd_mac_secret: bytes,
                  addr: str = "rebalancer", manifest_timeout: float = 2.0,
                  ack_timeout: float = 5.0, chunk_keys: int = 256,
-                 prune: bool = True):
+                 prune: bool = True, on_activate=None):
         self.manager = manager
         self.net = net
         self.secret = abd_mac_secret
@@ -65,6 +76,11 @@ class Rebalancer:
         self.manifest_timeout = manifest_timeout
         self.ack_timeout = ack_timeout
         self.chunk_keys = chunk_keys
+        # Meridian hook: fires (sync or async) with the activated map
+        # right after cut-over, BEFORE the prune — the multi-host
+        # controller broadcasts ShardMapActivate to every group agent
+        # here so remote /shards views and long-pollers see the bump
+        self.on_activate = on_activate
         # pruning the source group's moved keys after activation is the
         # production default; tests keep the pre-split state around to
         # assert zero stale-epoch writes ever landed there
@@ -137,14 +153,18 @@ class Rebalancer:
                          epoch=new_map.epoch) as span:
             try:
                 # freeze: both groups fence under the NEW map from here on
-                source.state.install(new_map)
-                target.state.install(new_map)
+                # (remote groups ack the install before anything streams —
+                # streaming into an unfenced group would break the
+                # immutable-while-copied guarantee)
+                await _maybe_await(source.state.install(new_map))
+                await _maybe_await(target.state.install(new_map))
                 smap = await self._migrate(source, target, new_map, support)
                 span["moved"] = smap
             except ReshardAborted:
                 raise
             except Exception as e:  # any unplanned failure aborts safely
-                self._abort(source, target, old_map, f"unexpected: {e!r}")
+                await self._abort(source, target, old_map,
+                                  f"unexpected: {e!r}")
             finally:
                 self.manager.end_reshard()
                 metrics.set("dds_shard_reshard_state", 0,
@@ -156,7 +176,7 @@ class Rebalancer:
         votes = await self._collect_manifests(source.active,
                                               source.quorum_size)
         if len(votes) < support:
-            self._abort(
+            await self._abort(
                 source, target, old_map,
                 f"manifest quorum failed: {len(votes)}/{len(source.active)} "
                 f"attested (need >= {support})",
@@ -183,7 +203,9 @@ class Rebalancer:
             )
 
         seeder = max(votes, key=coverage) if votes else None
-        exported = source.export_from(seeder) if seeder else {}
+        exported = (
+            await _maybe_await(source.export_from(seeder)) if seeder else {}
+        )
         entries = {k: e for k, e in exported.items() if k in moving}
 
         session = sigs.generate_nonce()
@@ -213,7 +235,7 @@ class Rebalancer:
         want = len(moving)
         good = [a for a in acks.values() if a.accepted >= want]
         if len(good) < target.quorum_size:
-            self._abort(
+            await self._abort(
                 source, target, old_map,
                 f"migration ack quorum failed: {len(good)}/{len(targets)} "
                 f"replicas accepted all {want} verified keys "
@@ -224,8 +246,10 @@ class Rebalancer:
         self.manager.activate(new_map)
         metrics.set("dds_shard_epoch", new_map.epoch,
                     help="active shard-map epoch")
+        if self.on_activate is not None:
+            await _maybe_await(self.on_activate(new_map))
         if self.prune:
-            dropped = source.prune_unowned()
+            dropped = await _maybe_await(source.prune_unowned())
             tracer.event("shard.pruned", source=source.gid, dropped=dropped)
         log.info(
             "reshard complete: %s -> %s, epoch %d, %d keys moved",
@@ -233,11 +257,22 @@ class Rebalancer:
         )
         return want
 
-    def _abort(self, source, target, old_map, reason: str) -> None:
+    async def _abort(self, source, target, old_map, reason: str) -> None:
         # roll fencing back to the old map (force: epoch goes backwards);
-        # the router never saw the new map, so routing is untouched
-        source.state.install(old_map, force=True)
-        target.state.install(old_map, force=True)
+        # the router never saw the new map, so routing is untouched. A
+        # REMOTE rollback can itself fail (agent unreachable) — the group
+        # then stays fenced under the orphaned epoch, which is safe
+        # (fencing rejects, never misroutes) and self-heals on the next
+        # install; it must not mask the abort itself.
+        for grp in (source, target):
+            try:
+                await _maybe_await(grp.state.install(old_map, force=True))
+            except Exception:
+                log.exception(
+                    "reshard abort could not roll %s back to epoch %d "
+                    "(group stays fenced until the next map install)",
+                    grp.gid, old_map.epoch,
+                )
         metrics.inc("dds_reshard_aborts_total",
                     help="live resharding attempts aborted safely")
         tracer.event("shard.reshard_abort", source=source.gid,

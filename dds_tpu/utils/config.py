@@ -247,9 +247,11 @@ class ShardConfig:
     keyspace across `count` independent BFT-ABD quorum groups, each with
     its own replicas, spares, supervisor, anti-entropy loop, and attack
     surface. Point ops route to one group; SumAll/MultAll scatter-gather
-    per-shard folds. Single-process (memory transport) topologies only —
-    the map-install step of a live reshard is an in-process config push;
-    multi-host map distribution is future work (DEPLOY.md "Sharding")."""
+    per-shard folds. With `transport.kind = "memory"` the whole
+    constellation lives in one process; with `"tcp"` the Meridian plane
+    ([fabric] section) spreads groups and proxies across OS processes,
+    distributing the signed map via GET /shards + epoch gossip
+    (DEPLOY.md "Sharding" and "Multi-host (Meridian)")."""
 
     enabled: bool = False
     count: int = 2
@@ -341,6 +343,51 @@ class AdmissionConfig:
 
 
 @dataclass
+class FabricConfig:
+    """Meridian multi-host shard fabric (dds_tpu/fabric): spread a
+    Constellation's S quorum groups plus separate proxies across N OS
+    processes/hosts over `TcpNet`, from one shared TOML that differs per
+    process only in `role` (and transport bind). Active with
+    `shard.enabled = true` + `transport.kind = "tcp"`.
+
+    Roles:
+    - `"all"`    — the whole constellation (groups + router + REST proxy)
+                   in this process, over real sockets;
+    - `"group:N"`— only quorum group sN (replicas, spares, supervisor,
+                   anti-entropy, Trudy) plus its fabric agent and a
+                   status listener serving the signed map at GET /shards;
+    - `"proxy"`  — the REST proxy + ShardRouter: bootstraps the shard map
+                   from `bootstrap` peers' signed GET /shards, stays
+                   fresh via epoch-gossip long-polls, and hosts the
+                   reshard controller (POST /_reshard when
+                   `admin-routes`).
+
+    `groups` maps every group id (including standby split targets not yet
+    in the map) to the TRANSPORT "host:port" of its owning process;
+    replica/supervisor/agent endpoint addresses derive from it plus the
+    homogeneous [shard] geometry, identically in every process.
+    DEPLOY.md "Multi-host (Meridian)" is the runbook."""
+
+    role: str = "all"
+    groups: dict = field(default_factory=dict)    # gid -> "host:port"
+    # REST "host:port" peers serving GET /shards (group status listeners
+    # and/or other proxies) — bootstrap + gossip sources
+    bootstrap: list[str] = field(default_factory=list)
+    # long-poll hold requested from gossip peers (seconds); the serving
+    # side caps it at proxy shards_wait_cap
+    gossip_wait: float = 25.0
+    # group-role status listener (GET /shards + /health + /metrics);
+    # empty host = transport.host, port 0 = OS-assigned
+    status_host: str = ""
+    status_port: int = 0
+    # enable POST /_reshard on proxy-role processes (operator control)
+    admin_routes: bool = False
+    # per-peer bootstrap attempt timeout; agent-RPC ack timeout
+    bootstrap_timeout: float = 3.0
+    rpc_timeout: float = 5.0
+
+
+@dataclass
 class AttackConfig:
     enabled: bool = False
     # crash | byzantine | partition | delay | flood | heal (the network
@@ -366,6 +413,7 @@ class DDSConfig:
     shard: ShardConfig = field(default_factory=ShardConfig)
     analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    fabric: FabricConfig = field(default_factory=FabricConfig)
     debug: bool = False
 
     # ------------------------------------------------------------- loading
@@ -416,5 +464,6 @@ _SUBSECTIONS = {
     ("DDSConfig", "shard"): ShardConfig,
     ("DDSConfig", "analytics"): AnalyticsConfig,
     ("DDSConfig", "admission"): AdmissionConfig,
+    ("DDSConfig", "fabric"): FabricConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
 }

@@ -490,10 +490,13 @@ def test_launch_constellation_end_to_end():
                 "127.0.0.1", dep.server.cfg.port, "GET",
                 f"/GetSet/{key.decode()}", timeout=5.0)
             assert st == 200 and json.loads(body)["contents"] == ["a", "b"]
-            # tcp transport is explicitly refused for sharded topologies
+            # tcp + shard routes through Meridian, which refuses an
+            # unknown fabric role without leaking the bound listener
             bad = DDSConfig()
             bad.shard.enabled = True
             bad.transport.kind = "tcp"
+            bad.transport.port = 0
+            bad.fabric.role = "bogus"
             with pytest.raises(ValueError):
                 await launch(bad)
         finally:
