@@ -1,0 +1,129 @@
+"""Lodestone resident-fold benchmark: warm fused aggregates vs per-fold
+marshaling.
+
+The structural claim of ISSUE 9 (and the HE-accelerator literature it
+follows — BTS, arxiv 2112.15479): aggregate throughput comes from keeping
+partitioned ciphertext lanes memory-resident and host<->device traffic
+index-only. The pre-Lodestone sharded aggregate re-marshals every
+operand's limbs (int -> (K, L) uint32) and dispatches S independent folds
+per request; the resident plane gathers each group's rows from its pinned
+pool and dispatches ONE fused gather+fold.
+
+Per shard count S this sweep measures, over the same operand sets and the
+same modulus:
+
+- cold  — the per-fold-marshaling baseline: per aggregate, S separate
+  `ints_to_batch` conversions + S `ModCtx.reduce_mul` dispatches + the
+  host `combine_partials` tail (exactly what the scatter path did);
+- warm  — `ResidentPlane.fold_groups` after ingest + compile warmup:
+  index lookup, one fused dispatch.
+
+Both are verified against the host-int reference fold before timing, and
+one `resident fold` record per S lands in results.json via
+benchmarks/common.emit() (value = warm aggregates/s, vs_baseline =
+cold_ms / warm_ms). benchmarks/sentry.py --check validates the records.
+
+Usage: python -m benchmarks.resident_fold [--k 256] [--shards 1,4]
+       [--bits 512] [--repeats 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import time
+
+from benchmarks.common import emit
+
+
+def _pyfold(cs, n):
+    acc = 1
+    for c in cs:
+        acc = acc * c % n
+    return acc
+
+
+def _drive(S: int, k: int, bits: int, repeats: int, seed: int) -> dict:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dds_tpu.ops import bignum as bn
+    from dds_tpu.ops.montgomery import ModCtx
+    from dds_tpu.parallel.mesh import combine_partials
+    from dds_tpu.resident import ResidentPlane
+
+    rng = random.Random(seed)
+    modulus = rng.getrandbits(bits) | (1 << (bits - 1)) | 1
+    per_group = max(2, k // S)
+    parts = [
+        (f"s{i}", [rng.randrange(1, modulus) for _ in range(per_group)])
+        for i in range(S)
+    ]
+    allops = [c for _, ops in parts for c in ops]
+    expect = _pyfold(allops, modulus)
+    ctx = ModCtx.make(modulus)
+
+    def cold_once() -> int:
+        # the per-fold marshaling baseline: host limbs + one dispatch per
+        # group + host tail combine (the pre-Lodestone scatter path)
+        partials = []
+        for _, ops in parts:
+            batch = bn.ints_to_batch([c % modulus for c in ops], ctx.L)
+            out = ctx.reduce_mul(jnp.asarray(batch))
+            partials.append(bn.limbs_to_int(np.asarray(out)[0]))
+        return combine_partials(partials, modulus)
+
+    plane = ResidentPlane(initial_rows=256,
+                          max_rows=max(256, 1 << (per_group * S).bit_length()))
+
+    # correctness gate before any timing: both paths must equal the host
+    # reference fold bit-for-bit
+    assert cold_once() == expect, "cold baseline diverged from host fold"
+    warm0 = plane.fold_groups(parts, modulus)  # ingest + compile warmup
+    assert warm0 == expect, "resident fused fold diverged from host fold"
+
+    cold_ms = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        cold_once()
+        cold_ms.append((time.perf_counter() - t0) * 1e3)
+    cold_once()  # keep compile caches warm symmetry
+
+    warm_ms = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        r = plane.fold_groups(parts, modulus)
+        warm_ms.append((time.perf_counter() - t0) * 1e3)
+        assert r == expect
+    return {
+        "shards": S,
+        "rows": len(allops),
+        "cold_ms": min(cold_ms),
+        "warm_ms": min(warm_ms),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--k", type=int, default=256,
+                    help="total operands per aggregate (split across S)")
+    ap.add_argument("--shards", default="1,4")
+    ap.add_argument("--bits", type=int, default=512)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=9)
+    args = ap.parse_args(argv)
+
+    rows = []
+    for S in [int(s) for s in args.shards.split(",") if s.strip()]:
+        d = _drive(S, args.k, args.bits, args.repeats, args.seed)
+        rows.append(emit(
+            f"resident fold (S={S}, K={d['rows']})",
+            1e3 / d["warm_ms"], "folds/s",
+            d["cold_ms"] / d["warm_ms"],  # >1 = warm beats marshaling
+            **d,
+        ))
+    return rows
+
+
+if __name__ == "__main__":
+    main()

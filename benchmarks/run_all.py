@@ -21,7 +21,7 @@ def main(argv=None):
     from benchmarks import (analytics_matvec, audit_cost, bft_sum, crossover,
                             encrypt_modexp, mixed, multihost_load,
                             overload_goodput, product, put_concurrency,
-                            shard_scaling, sweep)
+                            resident_fold, shard_scaling, sweep)
 
     rows = []
     if args.quick:
@@ -42,6 +42,10 @@ def main(argv=None):
         rows += multihost_load.main(
             ["--rates", "40,100", "--duration", "1.5", "--keys", "24"]
         )
+        rows += resident_fold.main(
+            ["--k", "64", "--shards", "1,2", "--bits", "256",
+             "--repeats", "2"]
+        )
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -55,6 +59,7 @@ def main(argv=None):
         rows += analytics_matvec.main([])
         rows += overload_goodput.main([])
         rows += multihost_load.main([])
+        rows += resident_fold.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

@@ -8,11 +8,12 @@ Modes (all emit one JSON line to stdout):
         baseline is caught before it silently disables gating.
         Also parses any `shard scaling` (benchmarks/shard_scaling.py),
         `analytics matvec` (benchmarks/analytics_matvec.py),
-        `overload goodput` (benchmarks/overload_goodput.py) and
-        `multihost load` (benchmarks/multihost_load.py) records in
+        `overload goodput` (benchmarks/overload_goodput.py),
+        `multihost load` (benchmarks/multihost_load.py) and
+        `resident fold` (benchmarks/resident_fold.py) records in
         benchmarks/results.json / results_quick.json so a malformed
-        scaling, analytics, overload or multihost record is caught by
-        the same smoke.
+        scaling, analytics, overload, multihost or resident record is
+        caught by the same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -181,6 +182,38 @@ def _check_overload_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_resident_records(root: str = REPO) -> dict:
+    """Validate `resident fold` rows (benchmarks/resident_fold.py):
+    positive folds/s value and a detail block naming the shard count,
+    total rows, and positive warm/cold timings (the warm-vs-marshaling
+    comparison the record exists for). Same malformed contract as the
+    other row families: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("resident fold")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("shards"), int)
+            and detail["shards"] >= 1
+            and isinstance(detail.get("rows"), int) and detail["rows"] >= 1
+            and isinstance(detail.get("warm_ms"), (int, float))
+            and detail["warm_ms"] > 0
+            and isinstance(detail.get("cold_ms"), (int, float))
+            and detail["cold_ms"] > 0
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed resident-fold record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _check_multihost_records(root: str = REPO) -> dict:
     """Validate `multihost load` rows (benchmarks/multihost_load.py):
     positive good-req/s value, a detail block naming the swept rates, the
@@ -262,6 +295,7 @@ def main(argv=None) -> int:
             analytics = _check_analytics_records()
             overload = _check_overload_records()
             multihost = _check_multihost_records()
+            resident = _check_resident_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -273,6 +307,7 @@ def main(argv=None) -> int:
             "analytics_rows": analytics["rows"],
             "overload_rows": overload["rows"],
             "multihost_rows": multihost["rows"],
+            "resident_rows": resident["rows"],
         }))
         return 0
 

@@ -48,12 +48,16 @@ from dds_tpu.utils.trace import tracer
 @dataclass
 class Prism:
     """The analytics engine one REST proxy owns: a ciphertext backend, the
-    per-request row cap, and (when sharded) the key -> group-id resolver
-    the scatter partition uses (None = unsharded, single dispatch)."""
+    per-request row cap, (when sharded) the key -> group-id resolver the
+    scatter partition uses (None = unsharded, single dispatch), and
+    (when Lodestone is armed) the resident plane whose per-group pools
+    the MatVec operand columns gather from — device-resident rows replace
+    the per-request host int -> limb marshaling on the device path."""
 
     backend: object
     max_rows: int = 256
     owner: Optional[Callable[[str], str]] = None
+    resident: object = None
 
     # ------------------------------------------------------------ validation
 
@@ -124,16 +128,29 @@ class Prism:
 
     # ------------------------------------------------------------ evaluation
 
-    def _partition(self, keys: list[str]) -> list[list[int]] | None:
-        """Column indices grouped by owning shard, or None when the whole
-        request is a single dispatch (unsharded, or one group owns all)."""
+    def _partition(self, keys: list[str]) -> list[tuple[str, list[int]]]:
+        """Column indices grouped by owning shard group id; unsharded =
+        one anonymous group (a single dispatch either way when only one
+        part comes back)."""
         if self.owner is None:
-            return None
+            return [("", list(range(len(keys))))]
         groups: dict[str, list[int]] = {}
         for i, k in enumerate(keys):
             groups.setdefault(self.owner(k), []).append(i)
-        parts = list(groups.values())
-        return parts if len(parts) > 1 else None
+        return list(groups.items())
+
+    def _gather(self, gid: str, sub_cs: list[int], rows: int, n2: int):
+        """Resident device rows for one group's operand columns, or None
+        when residency does not apply: no plane, a host backend (it works
+        from the ints), a below-crossover request (the host loop wins),
+        or a set wider than its pool. Residency is an optimization only —
+        None always degrades to the marshaling path."""
+        mdb = getattr(self.backend, "min_device_batch", None)
+        if self.resident is None or mdb is None:
+            return None
+        if rows * len(sub_cs) < mdb:
+            return None
+        return self.resident.rows_for(gid, n2, sub_cs)
 
     async def evaluate(
         self,
@@ -163,30 +180,36 @@ class Prism:
         backend_name = getattr(self.backend, "name", "?")
         with tracer.span(
             "analytics.matvec", rows=R, cols=K,
-            shards=len(parts) if parts else 1, backend=backend_name,
+            shards=len(parts), backend=backend_name,
         ):
-            if parts is not None:
+            if len(parts) > 1:
                 # one weighted fold per owning group, dispatched
                 # concurrently (each on a worker thread so device/host
                 # folds overlap), merged per row with the same tail
-                # combine the SumAll scatter path uses
+                # combine the SumAll scatter path uses; operands gather
+                # from each group's resident pool when Lodestone is armed
                 from dds_tpu.parallel.mesh import combine_partials
 
-                async def one(idxs: list[int]) -> list[int]:
+                async def one(gid: str, idxs: list[int]) -> list[int]:
                     sub_cs = [ciphers[i] for i in idxs]
                     sub_w = [[row[i] for i in idxs] for row in encoded]
+                    rows = self._gather(gid, sub_cs, R, n2)
                     return await asyncio.to_thread(
-                        self.backend.matvec, sub_cs, sub_w, n2
+                        self.backend.matvec, sub_cs, sub_w, n2, rows
                     )
 
-                partials = await asyncio.gather(*(one(ix) for ix in parts))
+                partials = await asyncio.gather(
+                    *(one(gid, ix) for gid, ix in parts)
+                )
                 out = [
                     combine_partials([p[r] for p in partials], n2)
                     for r in range(R)
                 ]
             else:
+                gid = parts[0][0] if parts else ""
+                rows = self._gather(gid, ciphers, R, n2)
                 out = await asyncio.to_thread(
-                    self.backend.matvec, ciphers, encoded, n2
+                    self.backend.matvec, ciphers, encoded, n2, rows
                 )
         metrics.observe(
             "dds_analytics_matvec_seconds", time.perf_counter() - t0,

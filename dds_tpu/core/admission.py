@@ -182,6 +182,11 @@ class AdmissionController:
         self._healthy_streak = 0
         self._last_eval = clock()
         self.transitions: list[dict] = []  # bounded history for /slo + tests
+        # transition subscribers (event-driven waits for harnesses and
+        # tests — the sleep-free alternative to polling `transitions`);
+        # invoked synchronously at transition time, exceptions swallowed
+        # so an observer can never wedge the decision loop
+        self._subscribers: list = []
 
     @classmethod
     def from_config(cls, acfg, alerts=None, breakers=None,
@@ -310,6 +315,19 @@ class AdmissionController:
                     help="Bulwark shed level (0=none; higher sheds lower "
                          "priority classes first)")
 
+    def subscribe(self, fn) -> None:
+        """Register a transition observer: `fn(record)` fires on every
+        shed/unshed transition (same dict shape as `transitions`
+        entries). The event-driven hook the overload harnesses wait on
+        instead of sleeping and polling."""
+        self._subscribers.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        try:
+            self._subscribers.remove(fn)
+        except ValueError:
+            pass
+
     def _transition(self, level: int, reason: str) -> None:
         direction = "shed" if level > self.shed_level else "unshed"
         prev, self.shed_level = self.shed_level, level
@@ -321,6 +339,11 @@ class AdmissionController:
         }
         self.transitions.append(record)
         del self.transitions[:-64]  # bounded history
+        for fn in list(self._subscribers):
+            try:
+                fn(dict(record))
+            except Exception:  # observers must never wedge the ratchet
+                pass
         tracer.event("admission." + direction, level=level, reason=reason)
         metrics.inc("dds_admission_transitions_total", direction=direction,
                     reason=reason,

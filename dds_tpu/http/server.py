@@ -196,6 +196,13 @@ class ProxyConfig:
     # microseconds, BEFORE a Deadline is minted. None/disabled = the
     # pre-Bulwark behavior (every request admitted).
     admission: object = None
+    # Lodestone resident ciphertext plane (dds_tpu/resident): a
+    # ResidentConfig-shaped object with enabled=True pins per-shard-group
+    # ciphertext limb pools device-side, ingests committed writes off the
+    # request path, and turns sharded SumAll/MultAll into ONE fused
+    # gather+fold dispatch instead of S per-group marshaling folds.
+    # None/disabled = the pre-Lodestone paths exactly.
+    resident: object = None
     # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
     replica_refresh_interval: float = 5.0
     supervisor: Optional[str] = None
@@ -275,9 +282,48 @@ class DDSRestServer:
         # path exactly as before
         self._shards = getattr(abd, "shard_manager", None)
         self._scatter_memo: tuple | None = None  # pairs identity -> shard operands
+        self._owner_memo: tuple | None = None    # pairs identity -> (gid, ops)
+        # Lodestone (dds_tpu/resident): per-group device-resident pools +
+        # the fused single-dispatch sharded fold. Built from the
+        # ResidentConfig-shaped cfg.resident; None when disabled — every
+        # gate below is a cheap is-None check. The plane rides the
+        # backend's kernel family/mesh when the backend exposes them
+        # (TpuBackend.resident_plane); host backends get the portable
+        # jnp plane (same math, same single dispatch).
+        rescfg = self.cfg.resident
+        self._resident = None
+        self._resident_min_fold = 0
+        self._resident_write_ingest = False
+        self._resident_ingest_window = 0.005
+        self._ingest_task: asyncio.Task | None = None
+        if rescfg is not None and getattr(rescfg, "enabled", False):
+            initial = getattr(rescfg, "initial_rows", 256)
+            max_rows = getattr(rescfg, "max_rows", 65536)
+            if hasattr(self.backend, "resident_plane"):
+                self._resident = self.backend.resident_plane(initial, max_rows)
+            else:
+                from dds_tpu.resident import ResidentPlane
+
+                self._resident = ResidentPlane(
+                    initial_rows=initial, max_rows=max_rows
+                )
+            mf = getattr(rescfg, "min_fold", 0)
+            self._resident_min_fold = (
+                mf if mf > 0 else getattr(self.backend, "min_device_batch", 0)
+            )
+            self._resident_write_ingest = getattr(rescfg, "write_ingest", True)
+            self._resident_ingest_window = max(
+                0.0, getattr(rescfg, "ingest_window", 0.005)
+            )
+            group_ids = getattr(self.abd, "group_ids", None)
+            if group_ids is not None:
+                # deterministic group -> mesh-slice placement up front
+                self._resident.register_groups(group_ids())
         # Prism analytics engine (analytics/prism): same backend, same
         # public-parameter boundary; sharded proxies hand it the router's
-        # owner resolver so weighted folds scatter-gather like SumAll
+        # owner resolver so weighted folds scatter-gather like SumAll,
+        # and the resident plane so MatVec operands gather from pinned
+        # rows instead of re-marshaling host ints
         if self.cfg.analytics_enabled:
             from dds_tpu.analytics import Prism
             from dds_tpu.ops.flags import analytics_max_rows
@@ -286,6 +332,7 @@ class DDSRestServer:
                 backend=self.backend,
                 max_rows=analytics_max_rows(self.cfg.analytics_max_rows),
                 owner=(self.abd.owner if self._shards is not None else None),
+                resident=self._resident,
             )
         else:
             self.prism = None
@@ -345,6 +392,9 @@ class DDSRestServer:
                         fut.set_exception(err)
             self._fold_pending.clear()
             self._fold_drainer = None
+        if self._ingest_task is not None:
+            await _cancel_task(self._ingest_task)
+            self._ingest_task = None
         if self._keys_saver is not None:
             await _cancel_task(self._keys_saver)
             self._keys_saver = None
@@ -549,7 +599,52 @@ class DDSRestServer:
             lambda: self.abd.write_set_tagged(key, value, deadline=dl), dl
         )
         self._cache_put(key, tag, value)
+        self._note_resident_write(key, value)
         return k
+
+    # --------------------------------------------- Lodestone write ingest
+
+    def _note_resident_write(self, key: str, value) -> None:
+        """Queue a committed write's ciphertext columns for resident-pool
+        ingest (dds_tpu/resident) — OFF the request's critical path,
+        coalesced like folds — so a warm fleet's first post-write
+        aggregate gathers every row device-side with zero ingest.
+        Content addressing keeps this unconditionally safe: the full
+        quorum read still decides which ciphertexts fold; the pool only
+        pre-pays their limb conversion + transfer."""
+        plane = self._resident
+        if plane is None or not self._resident_write_ingest or not value:
+            return
+        ciphers = []
+        for col in value:
+            if isinstance(col, bool):
+                continue
+            if isinstance(col, int):
+                ciphers.append(col)
+            elif isinstance(col, str):
+                try:
+                    ciphers.append(int(col))
+                except ValueError:
+                    continue  # non-numeric column: never an aggregate operand
+        if not ciphers:
+            return
+        gid = self.abd.owner(key) if self._shards is not None else ""
+        if plane.note_write(gid, ciphers):
+            self._resident_ingest_soon()
+
+    def _resident_ingest_soon(self) -> None:
+        """Debounced drain: coalesce a write burst into few ingest
+        dispatches (the _save_keys_soon pattern), each on a worker
+        thread so limb conversion never stalls request handling."""
+        if self._ingest_task is not None and not self._ingest_task.done():
+            return
+
+        async def _drain():
+            while self._resident.pending_ingest():
+                await asyncio.sleep(self._resident_ingest_window)
+                await asyncio.to_thread(self._resident.ingest_pending)
+
+        self._ingest_task = asyncio.ensure_future(_drain())
 
     async def _fetch_stored(self) -> list[tuple[str, list]]:
         """Every stored (key, value), for the aggregate/search routes.
@@ -1095,6 +1190,10 @@ class DDSRestServer:
                     health["shards"] = shards
                     health["shard_epoch"] = self._shards.epoch
                     health["reshard_state"] = self._shards.state
+                if self._resident is not None:
+                    # Lodestone surface: per-pool residency, HBM bytes,
+                    # reset churn, and the pending write-ingest queue
+                    health["resident"] = self._resident.stats()
                 recovery = self._recovery_status()
                 if recovery is not None:
                     health["recovery"] = recovery
@@ -1257,6 +1356,10 @@ class DDSRestServer:
                 self._coalescer.window(),
                 help="current adaptive fold-coalescing window",
             )
+        if self._resident is not None:
+            # Lodestone gauges: dds_resident_{rows,bytes,hit_ratio,
+            # resets}{shard=...}, aggregated per group at scrape time
+            self._resident.export_gauges(metrics)
         # SLO burn/budget gauges + audit backlog (scrape-time freshness is
         # all a gauge promises; the violation COUNTER increments at
         # detection time in the auditor itself)
@@ -1372,6 +1475,26 @@ class DDSRestServer:
         )
         if mod:
             modulus = self._parse_modulus(mod, modparam)
+            result = None
+            if (
+                self._resident is not None
+                and len(operands) >= self._resident_min_fold
+            ):
+                # Lodestone: route per-owner operand sets to their group
+                # pools and run ONE fused gather+fold dispatch (per-group
+                # local tree + the combine_partials tail tree, on-device)
+                # instead of S separate marshaling folds. Falls through
+                # (None) only when an operand set is wider than its pool
+                # even after a reset.
+                parts = self._owner_operands(pairs, pos)
+                with tracer.span("proxy.resident_fold", k=len(operands),
+                                 shards=len(parts),
+                                 backend=self.backend.name):
+                    result = await asyncio.to_thread(
+                        self._resident.fold_groups, parts, modulus
+                    )
+            if result is not None:
+                return Response.json(J.value_result(str(result)))
             shard_ops = (
                 self._shard_operands(pairs, pos)
                 if self._shards is not None else None
@@ -1468,6 +1591,25 @@ class DDSRestServer:
             {"result": [str(c) for c in out], "keys": keys}
         )
 
+    def _owner_operands(self, pairs, pos: int) -> list[tuple[str, list[int]]]:
+        """Aggregate operands partitioned by owning shard group, with the
+        group id attached (the Lodestone pool key). Unsharded proxies get
+        one anonymous group. Memoized per pairs-identity like the flat
+        operand memo — between writes the partition is state-identical,
+        and the stable operand-list identities are what the pools' row-
+        index memos key on."""
+        memo = self._owner_memo
+        if memo is not None and memo[0] is pairs and memo[1] == pos:
+            return memo[2]
+        groups: dict[str, list[int]] = {}
+        for k, v in pairs:
+            if pos < len(v):
+                gid = self.abd.owner(k) if self._shards is not None else ""
+                groups.setdefault(gid, []).append(int(v[pos]))
+        out = [(gid, g) for gid, g in groups.items() if g]
+        self._owner_memo = (pairs, pos, out)
+        return out
+
     def _shard_operands(self, pairs, pos: int) -> list[list[int]]:
         """Aggregate operands partitioned by owning shard group (memoized
         per pairs-identity like the flat operand memo — between writes the
@@ -1475,11 +1617,7 @@ class DDSRestServer:
         memo = self._scatter_memo
         if memo is not None and memo[0] is pairs and memo[1] == pos:
             return memo[2]
-        groups: dict[str, list[int]] = {}
-        for k, v in pairs:
-            if pos < len(v):
-                groups.setdefault(self.abd.owner(k), []).append(int(v[pos]))
-        out = [g for g in groups.values() if g]
+        out = [g for _, g in self._owner_operands(pairs, pos)]
         self._scatter_memo = (pairs, pos, out)
         return out
 

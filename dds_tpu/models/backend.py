@@ -34,7 +34,8 @@ class CryptoBackend(Protocol):
     def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]: ...
 
     def matvec(
-        self, cs: list[int], weights: list[list[int]], modulus: int
+        self, cs: list[int], weights: list[list[int]], modulus: int,
+        rows: object = None,
     ) -> list[int]: ...
 
 
@@ -72,8 +73,11 @@ class CpuBackend:
         return [pow(b, exp, modulus) for b in bases]
 
     def matvec(
-        self, cs: list[int], weights: list[list[int]], modulus: int
+        self, cs: list[int], weights: list[list[int]], modulus: int,
+        rows: object = None,
     ) -> list[int]:
+        # `rows` (pre-gathered device limbs, Lodestone) is a device-path
+        # optimization; the host loop works from the ints either way
         return _host_matvec(cs, weights, modulus)
 
 
@@ -186,11 +190,37 @@ class TpuBackend:
 
     def _mesh_kernel(self) -> str:
         """The single kernel-family rule for every composite fold path —
-        mesh-sharded (parallel/mesh.py) AND coalesced (ops/foldmany):
-        the SAME family the single-chip path would use (v1/v2 when pallas
-        is on, the portable jnp scans otherwise), so scale-out and
-        batching never silently run a slower kernel."""
+        mesh-sharded (parallel/mesh.py), coalesced (ops/foldmany) AND
+        resident-fused (dds_tpu/resident): the SAME family the
+        single-chip path would use (v1/v2 when pallas is on, the portable
+        jnp scans otherwise), so scale-out and batching never silently
+        run a slower kernel."""
         return self.kernel if self.pallas else "jnp"
+
+    def fold_kernel(self) -> str:
+        """Public alias of the composite-fold kernel rule — what the
+        Lodestone ResidentPlane builds its fused dispatch on."""
+        return self._mesh_kernel()
+
+    def resident_plane(self, initial_rows: int = 256,
+                       max_rows: int = 1 << 20):
+        """A Lodestone ResidentPlane wired to THIS backend's kernel
+        family, mesh, and per-pool reduce — so lone-group resident folds
+        and fused sharded folds run exactly the kernels the flat paths
+        would (one dispatch rule, one kernel rule)."""
+        from dds_tpu.resident import ResidentPlane
+
+        def reduce_factory(modulus: int):
+            ctx = ModCtx.make(modulus)
+            return lambda rows: self.reduce_mul_device(ctx, rows)
+
+        return ResidentPlane(
+            kernel=self.fold_kernel(),
+            mesh=self._get_mesh(),
+            initial_rows=initial_rows,
+            max_rows=max_rows,
+            reduce_factory=reduce_factory,
+        )
 
     def _get_mesh(self):
         if self.mesh is None and self._mesh_n > 1:
@@ -239,13 +269,16 @@ class TpuBackend:
         return foldmany.fold_many(folds, modulus, kernel=self._mesh_kernel())
 
     def matvec(
-        self, cs: list[int], weights: list[list[int]], modulus: int
+        self, cs: list[int], weights: list[list[int]], modulus: int,
+        rows: object = None,
     ) -> list[int]:
         """Plaintext-matrix x ciphertext-vector products (Prism / PC-MM):
         one batched weighted-fold dispatch (ops/foldmany.fold_weighted)
         when the R*K cell count clears the device crossover; below it the
         host loop wins for the same dispatch-latency reason small
-        aggregates do."""
+        aggregates do. `rows` optionally supplies the operands as
+        already-gathered device limbs from a Lodestone resident pool, so
+        the device path skips host int -> limb marshaling entirely."""
         if len(weights) * len(cs) < self.min_device_batch:
             from dds_tpu.native import powmod
 
@@ -253,7 +286,7 @@ class TpuBackend:
         from dds_tpu.ops import foldmany
 
         return foldmany.fold_weighted(
-            cs, weights, modulus, kernel=self._mesh_kernel()
+            cs, weights, modulus, kernel=self._mesh_kernel(), rows=rows
         )
 
     def powmod_batch(self, bases: list[int], exp: int, modulus: int) -> list[int]:
@@ -315,7 +348,8 @@ class NativeBackend:
         return native.powmod_batch(bases, exp, modulus)
 
     def matvec(
-        self, cs: list[int], weights: list[list[int]], modulus: int
+        self, cs: list[int], weights: list[list[int]], modulus: int,
+        rows: object = None,
     ) -> list[int]:
         from dds_tpu.native import powmod
 

@@ -165,7 +165,8 @@ def _fold_weighted_fn(ctx: ModCtx, kernel: str):
 
 
 def fold_weighted(
-    cs: list[int], weights: list[list[int]], modulus: int, kernel: str = "jnp"
+    cs: list[int], weights: list[list[int]], modulus: int, kernel: str = "jnp",
+    rows=None,
 ) -> list[int]:
     """Per-row weighted modular products, one device dispatch:
 
@@ -190,6 +191,12 @@ def fold_weighted(
     modulus): nothing here touches secret key material, so ModCtx's global
     cache and the persistent compile cache are safe — ADVICE.md's
     secret-CRT-parameter concern does not apply to this path.
+
+    `rows` optionally supplies the operands as an already-device-resident
+    (K, L) plain-domain limb array (a Lodestone pool gather,
+    dds_tpu/resident): the int -> limb marshaling of `cs` is skipped and
+    only the pad rows are host-built. `cs` is still required — it carries
+    the operand count and the host-side weight validation.
     """
     ctx = ModCtx.make(modulus)
     K, R_real = len(cs), len(weights)
@@ -208,7 +215,13 @@ def fold_weighted(
                 )
     P2 = 1 << max(0, (K - 1).bit_length())
     Rp = 1 << max(0, (R_real - 1).bit_length())
-    arr = bn.ints_to_batch(list(cs) + [1] * (P2 - K), ctx.L)
+    if rows is not None and getattr(rows, "shape", None) == (K, ctx.L):
+        arr = jnp.asarray(rows)
+        if P2 != K:
+            pad = jnp.asarray(bn.ints_to_batch([1] * (P2 - K), ctx.L))
+            arr = jnp.concatenate([arr, pad], axis=0)
+    else:
+        arr = bn.ints_to_batch(list(cs) + [1] * (P2 - K), ctx.L)
     E = max((w.bit_length() for row in weights for w in row), default=0)
     D = max(1, -(-E // _WINDOW))
     digits = np.zeros((D, Rp, P2), np.int32)

@@ -1,192 +1,32 @@
-"""Content-addressed device-resident ciphertext store.
+"""Content-addressed device-resident ciphertext store (compat surface).
 
-The proxy's aggregates (`SumAll`/`MultAll`, `dds/http/DDSRestServer.scala:
-397-446,491-540`) fold the same stored ciphertexts on every request; the
-reference re-runs a JVM BigInteger loop over them each time. Here the limb
-decompositions live in TPU HBM between requests: each distinct ciphertext
-*value* is ingested once (int -> 16-bit limbs -> device row) and every
-subsequent aggregate gathers resident rows on-device and tree-reduces.
+The single-store `DeviceCipherStore` of the pre-Lodestone tree is now a
+thin alias of `dds_tpu.resident.pool.ResidentPool` — the per-shard-group
+pool family the Constellation's fused aggregates gather from (see
+dds_tpu/resident/). The class keeps its name, constructor signature and
+`fold`/`ensure`/`resident`/`capacity` surface here so existing backends
+and tests are untouched; new code should import `ResidentPool` (and the
+`ResidentPlane` that owns one per group) from `dds_tpu.resident`.
 
-Content addressing (ciphertext int -> row) is what keeps the dependability
-story intact: the proxy still performs full ABD quorum reads per aggregate
-— the store only memoizes the transfer/limb-conversion of bytes the device
-has already seen, so a stale cache entry cannot exist by construction.
-
-Capacity grows by doubling up to `max_rows`; beyond that the store resets
-(entries re-ingest on demand) — simple, and an aggregate after a reset
-pays exactly the one-time ingest cost again, never wrong results.
+Semantics preserved from the original store: each distinct ciphertext
+*value* ingests once (int -> 16-bit limbs -> device row); aggregates
+gather resident rows on-device; content addressing means a stale entry
+cannot exist by construction (the proxy's full quorum reads still decide
+WHICH ciphertexts fold); capacity doubles up to `max_rows`, beyond which
+the store resets and re-ingests on demand. One accounting fix rides the
+move: the wider-than-`max_rows` direct-fold fallback now reports its
+operands as `outcome="direct"` in `dds_cipher_store_total` instead of
+misreporting them as resident (every limb was host-marshaled).
 """
 
 from __future__ import annotations
 
-import logging
-import threading
-from dataclasses import dataclass, field
-
-import numpy as np
-
-from dds_tpu.obs import kprof
-from dds_tpu.obs.metrics import metrics
-from dds_tpu.ops import bignum as bn
-from dds_tpu.ops.montgomery import ModCtx
-from dds_tpu.utils.trace import tracer
-
-log = logging.getLogger("dds.store")
+from dds_tpu.resident.pool import ResidentPool
 
 
-@dataclass
-class DeviceCipherStore:
-    """Resident (rows, L) uint32 limb buffer for one modulus.
+class DeviceCipherStore(ResidentPool):
+    """Resident (rows, L) uint32 limb buffer for one modulus — the
+    unsharded (single-pool) alias of `ResidentPool`."""
 
-    `reduce` is the device-level fold callable ((K, L) array -> (1, L));
-    backends inject theirs (TpuBackend.reduce_mul_device) so kernel
-    dispatch lives in exactly one place. Default: the jnp reference path.
-    """
 
-    modulus: int
-    reduce: object = None
-    initial_rows: int = 256
-    max_rows: int = 1 << 20  # ~1 GiB of HBM at L=256
-    _ctx: ModCtx = field(init=False, repr=False)
-    _buf: object = field(init=False, repr=False)   # jnp (cap, L) uint32
-    _index: dict[int, int] = field(init=False, repr=False)
-    _count: int = field(init=False, default=0, repr=False)
-
-    def __post_init__(self):
-        import jax.numpy as jnp
-
-        self._ctx = ModCtx.make(self.modulus)
-        if self.reduce is None:
-            self.reduce = self._ctx.reduce_mul
-        self._buf = jnp.zeros((self.initial_rows, self._ctx.L), jnp.uint32)
-        self._index = {}
-        # (cs-list identity, epoch, idx array): aggregates pass the same
-        # operand list object while the proxy's caches validate unchanged,
-        # so the O(K) big-int index lookups run once per distinct list.
-        # The strong ref keeps the keyed list alive (identity stays unique);
-        # epoch invalidates across capacity resets.
-        self._idx_memo: tuple | None = None
-        self._epoch = 0
-        # folds may run on proxy worker threads; ingest (index+buffer
-        # mutation) must be serialized. Reads gather from an immutable
-        # buffer snapshot, so only `ensure` needs the lock.
-        self._lock = threading.Lock()
-
-    @property
-    def resident(self) -> int:
-        return self._count
-
-    @property
-    def capacity(self) -> int:
-        return int(self._buf.shape[0])
-
-    def _grow(self, need: int) -> None:
-        import jax.numpy as jnp
-
-        cap = self.capacity
-        while cap < need:
-            cap *= 2
-        if cap > self.max_rows:
-            log.warning(
-                "cipher store over max_rows (%d > %d): resetting", need, self.max_rows
-            )
-            self._index.clear()
-            self._count = 0
-            self._epoch += 1  # row indices changed: invalidate idx memos
-            cap = max(self.initial_rows, min(cap, self.max_rows))
-            self._buf = jnp.zeros((cap, self._ctx.L), jnp.uint32)
-            return
-        pad = jnp.zeros((cap - self.capacity, self._ctx.L), jnp.uint32)
-        self._buf = jnp.concatenate([self._buf, pad], axis=0)
-
-    def ensure(self, cs: list[int], pre: dict | None = None) -> np.ndarray | None:
-        """Ingest any unseen ciphertexts; return row indices for all of cs.
-        Caller must hold `_lock`. `pre` optionally maps ciphertext -> already
-        limb-converted row (fold() precomputes these OUTSIDE the lock so the
-        CPU-heavy conversion never serializes concurrent folds).
-
-        Returns None when the distinct operands cannot fit even after a
-        reset (aggregate wider than max_rows) — callers fall back to a
-        direct, non-resident fold."""
-        import jax
-        import jax.numpy as jnp
-
-        missing = sorted({c for c in cs if c not in self._index})
-        if missing:
-            if self._count + len(missing) > self.capacity:
-                self._grow(self._count + len(missing))
-                missing = sorted({c for c in cs if c not in self._index})
-            if self._count + len(missing) > self.capacity:
-                return None  # wider than max_rows even when empty
-            if pre is not None and all(c in pre for c in missing):
-                rows = np.stack([pre[c] for c in missing])
-            else:
-                rows = bn.ints_to_batch(
-                    [c % self.modulus for c in missing], self._ctx.L
-                )
-            start = self._count
-            self._buf = jax.lax.dynamic_update_slice(
-                self._buf, jnp.asarray(rows), (start, 0)
-            )
-            for i, c in enumerate(missing):
-                self._index[c] = start + i
-            self._count += len(missing)
-        return np.asarray([self._index[c] for c in cs], dtype=np.int32)
-
-    def fold(self, cs: list[int]) -> int:
-        """prod(cs) mod modulus, gathering resident rows on-device."""
-        import jax.numpy as jnp
-
-        if not cs:
-            return 1 % self.modulus
-        # fast path: everything resident — only a brief lock for the lookup
-        with self._lock:
-            m = self._idx_memo
-            if m is not None and m[0] is cs and m[1] == self._epoch:
-                idx = m[2]
-                buf = self._buf
-                missing = ()
-            else:
-                missing = sorted({c for c in cs if c not in self._index})
-                if not missing:
-                    idx = np.asarray(
-                        [self._index[c] for c in cs], dtype=np.int32
-                    )
-                    self._idx_memo = (cs, self._epoch, idx)
-                    buf = self._buf  # immutable jax array: safe outside
-                else:
-                    idx = buf = None
-        if buf is None:
-            # limb-convert the unseen operands OUTSIDE the lock (the
-            # CPU-heavy part); placement/index update stays serialized.
-            # Entries are only ever added, so `missing` can only shrink in
-            # between; ensure() recomputes it under the lock (and converts
-            # inline in the rare capacity-reset case where `pre` is short).
-            converted = bn.ints_to_batch(
-                [c % self.modulus for c in missing], self._ctx.L
-            )
-            pre = {c: converted[i] for i, c in enumerate(missing)}
-            with self._lock:
-                idx = self.ensure(cs, pre)
-                if idx is not None:
-                    self._idx_memo = (cs, self._epoch, idx)
-                buf = self._buf
-        if idx is None:  # aggregate wider than the store: direct fold
-            rows = jnp.asarray(
-                bn.ints_to_batch([c % self.modulus for c in cs], self._ctx.L)
-            )
-        else:
-            rows = jnp.take(buf, jnp.asarray(idx), axis=0)
-        metrics.inc(
-            "dds_cipher_store_total", len(cs) - len(missing), outcome="resident",
-            help="fold operands served from device-resident rows vs ingested",
-        )
-        metrics.inc("dds_cipher_store_total", len(missing), outcome="ingested",
-                    help="fold operands served from device-resident rows vs ingested")
-        with tracer.span("kernel.fold", k=len(cs), resident=idx is not None):
-            # dispatch (trace/compile) timed apart from block_until_ready
-            # device execution (obs/kprof) — the split the flat span hid
-            out = kprof.profiled(
-                "store.reduce", lambda: self.reduce(rows), k=len(cs),
-            )
-            return bn.limbs_to_int(np.asarray(out)[0])
+__all__ = ["DeviceCipherStore"]

@@ -54,6 +54,20 @@ def _check_kernel(kernel: str) -> str:
     return kernel
 
 
+def group_sharding(mesh: Mesh | None, index: int, axis: str = "batch"):
+    """NamedSharding pinning one shard group's resident pool (Lodestone,
+    dds_tpu/resident) to its slice of the mesh: group `index` maps round-
+    robin onto the mesh's devices, and the pool's (rows, L) buffer lives
+    wholly on that device via a one-device sub-mesh + replicated
+    PartitionSpec — so the fused sharded fold gathers each group's rows
+    where they already are. None (no mesh, or a single device — the test
+    fabric) means default placement: exactly the pre-Lodestone buffer."""
+    if mesh is None or mesh.devices.size <= 1:
+        return None
+    dev = mesh.devices.flat[index % mesh.devices.size]
+    return NamedSharding(Mesh(np.array([dev]), (axis,)), P())
+
+
 # jitted shard_map executables, keyed by (op, modulus, mesh, axis, kernel):
 # the serving path calls these per aggregate request, and rebuilding the
 # closure each call would defeat jax.jit's trace cache (jit keys on

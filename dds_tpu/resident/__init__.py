@@ -1,0 +1,12 @@
+"""Lodestone: mesh-fused device-resident ciphertext plane.
+
+Per-shard-group content-addressed limb pools pinned in device memory
+(`ResidentPool`), write-path incremental ingest, and single-dispatch
+sharded gather+fold aggregates (`ResidentPlane.fold_groups`). See
+DEPLOY.md "Resident ciphertext plane (Lodestone)".
+"""
+
+from dds_tpu.resident.plane import ResidentPlane
+from dds_tpu.resident.pool import ResidentPool
+
+__all__ = ["ResidentPlane", "ResidentPool"]

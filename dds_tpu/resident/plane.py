@@ -1,0 +1,349 @@
+"""Lodestone: the mesh-fused device-resident ciphertext plane.
+
+`ResidentPlane` owns one `ResidentPool` per (shard group, modulus) and
+turns a sharded aggregate — operand sets partitioned by owning
+Constellation group — into ONE device dispatch: per-group rows gather
+from their pools, fold locally (a halving tree per group slab), and the
+per-group partials merge with the same log2(S) tail tree
+`parallel/mesh.sharded_reduce_mul` runs across chips. Before this plane
+the proxy dispatched S independent folds per sharded aggregate and
+re-marshaled host limbs into every one of them; warm aggregates now
+touch host ints only to look up row indices.
+
+Placement: with a multi-device mesh each group's pool pins to its mesh
+slice (`parallel/mesh.group_sharding`, NamedSharding/PartitionSpec) and
+the fused fold runs the per-group slabs under `shard_map` with one
+all_gather of (S, L) partials — the BTS-style lane partitioning where
+ciphertext lanes stay memory-resident and host<->device traffic is
+index-only. On a single device (the test fabric) everything degrades to
+one jit over default-placed buffers: same math, same single dispatch.
+
+R-power accounting for the fused tree (structure-independent, same
+argument as `parallel/mesh._tree_reduce_local`): K real operands plus
+any number of Montgomery-identity pads through any tree shape yield
+prod * R^-(K-1); one final multiply by R^K mod n fixes the domain.
+
+The write-path ingest queue (`note_write` / `ingest_pending`) lets the
+proxy push committed ciphertexts into existing pools OFF the request's
+critical path, coalesced like folds — a warm fleet's first post-write
+aggregate then pays zero ingest. Content addressing makes this safe: an
+ingested row is keyed by its value, so a racing aggregate either finds
+the row (identical bytes) or ingests it itself; nothing can go stale.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from dds_tpu.obs import kprof
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.ops import bignum as bn
+from dds_tpu.ops.flags import karatsuba_mode
+from dds_tpu.ops.montgomery import ModCtx
+from dds_tpu.resident.pool import ResidentPool
+
+KERNELS = ("jnp", "v1", "v2")
+
+# jitted fused-fold executables, keyed by (modulus, S, kernel family,
+# interpret, karatsuba mode, mesh, axis): shapes retrace per input under
+# one entry (like parallel/mesh's "reduce" cache), the bounded FIFO caps
+# client-driven modulus churn exactly like the other kernel caches.
+_FN_CACHE: dict = {}
+_FN_CACHE_MAX = 64
+_FN_CACHE_LOCK = threading.Lock()
+
+
+def _interpret_default() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _fused_fold_fn(ctx: ModCtx, S: int, kernel: str, mesh, axis: str):
+    """ONE compiled callable per (modulus, S, kernel, mesh): gathers each
+    group's rows from its pool buffer, pads to the common power-of-two
+    width with the Montgomery identity, tree-folds every group slab, and
+    tail-combines the S partials — all inside a single dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    interpret = _interpret_default()
+    kmode = karatsuba_mode() if kernel == "v2" else None
+    use_mesh = (
+        mesh is not None and mesh.devices.size > 1
+        and S % mesh.devices.size == 0
+    )
+    key = ("fused", ctx.n, S, kernel, interpret, kmode,
+           mesh if use_mesh else None, axis)
+    fn = _FN_CACHE.get(key)
+    kprof.cache_event("resident_fold", hit=fn is not None)
+    if fn is not None:
+        return fn
+
+    from dds_tpu.ops.foldmany import _mul_bm
+    from jax.sharding import PartitionSpec as P
+
+    mul = _mul_bm(ctx, kernel, interpret)
+    one_mont = jnp.asarray(ctx.one_mont)
+    L = ctx.L
+
+    def local_tree(stack):
+        # (G, P2, L) -> (G, L): halving tree over the operand axis of
+        # every group slab at once, no collectives
+        t = stack
+        while t.shape[1] > 1:
+            h = t.shape[1] // 2
+            t = mul(
+                t[:, :h].reshape(-1, L), t[:, h : 2 * h].reshape(-1, L)
+            ).reshape(t.shape[0], h, L)
+        return t[:, 0]
+
+    def tail(partials):
+        # (S, L) -> (1, L): the combine_partials tail tree, on-device
+        t = partials
+        while t.shape[0] > 1:
+            if t.shape[0] % 2:
+                t = jnp.concatenate([t, one_mont[None, :]], axis=0)
+            t = mul(t[0::2], t[1::2])
+        return t
+
+    if use_mesh:
+        step = jax.shard_map(
+            lambda local: tail(
+                jax.lax.all_gather(local_tree(local), axis, tiled=True)
+            ),
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=P(),  # replicated combined partial
+            check_vma=False,
+        )
+    else:
+        step = lambda stack: tail(local_tree(stack))  # noqa: E731
+
+    def run(bufs, idxs, fix):
+        P2 = 1
+        for idx in idxs:
+            P2 = max(P2, 1 << max(0, (idx.shape[0] - 1).bit_length()))
+        slabs = []
+        for buf, idx in zip(bufs, idxs):
+            rows = jnp.take(buf, idx, axis=0)
+            pad = P2 - rows.shape[0]
+            if pad:
+                rows = jnp.concatenate(
+                    [rows, jnp.broadcast_to(one_mont, (pad, L))], axis=0
+                )
+            slabs.append(rows)
+        return mul(step(jnp.stack(slabs)), fix)
+
+    fn = jax.jit(run)
+    with _FN_CACHE_LOCK:
+        while len(_FN_CACHE) >= _FN_CACHE_MAX:
+            _FN_CACHE.pop(next(iter(_FN_CACHE)), None)
+        _FN_CACHE[key] = fn
+    return fn
+
+
+class ResidentPlane:
+    """Per-group resident pools + the fused single-dispatch sharded fold.
+
+    `kernel` picks the Montgomery multiply family for the fused fold
+    (same rule as the backend's composite paths: v1/v2 on real TPU, the
+    portable jnp scans elsewhere). `mesh`/`axis` enable mesh placement;
+    None is the single-device fallback. `reduce_factory(modulus)`
+    optionally supplies the per-pool single-fold reduce (backends inject
+    theirs so lone-group folds use the same kernels as before)."""
+
+    def __init__(self, kernel: str = "jnp", mesh=None, axis: str = "batch",
+                 initial_rows: int = 256, max_rows: int = 1 << 20,
+                 reduce_factory=None, max_pending: int = 8192):
+        self.kernel = kernel if kernel in KERNELS else "jnp"
+        self.mesh = mesh
+        self.axis = axis
+        self.initial_rows = int(initial_rows)
+        self.max_rows = int(max_rows)
+        self.max_pending = int(max_pending)
+        self._reduce_factory = reduce_factory
+        self._lock = threading.Lock()
+        self._pools: dict[tuple[str, int], ResidentPool] = {}
+        self._order: dict[str, int] = {}  # gid -> mesh slice index
+        self._pending: dict[str, list[int]] = {}  # gid -> queued write ingests
+        self._dropped_pending = 0
+
+    # ------------------------------------------------------------- topology
+
+    def register_groups(self, gids) -> None:
+        """Pin group -> mesh-slice assignment order up front (lazy
+        first-use registration works too, but explicit registration keeps
+        placement deterministic across proxy restarts)."""
+        with self._lock:
+            for gid in gids:
+                self._order.setdefault(gid, len(self._order))
+
+    def pool(self, gid: str, modulus: int) -> ResidentPool:
+        with self._lock:
+            idx = self._order.setdefault(gid, len(self._order))
+            key = (gid, modulus)
+            p = self._pools.get(key)
+            if p is None:
+                from dds_tpu.parallel.mesh import group_sharding
+
+                p = self._pools[key] = ResidentPool(
+                    modulus,
+                    reduce=(
+                        self._reduce_factory(modulus)
+                        if self._reduce_factory is not None else None
+                    ),
+                    initial_rows=self.initial_rows,
+                    max_rows=self.max_rows,
+                    gid=gid,
+                    sharding=group_sharding(self.mesh, idx, self.axis),
+                )
+            return p
+
+    # ----------------------------------------------------- write-path ingest
+
+    def note_write(self, gid: str, ciphers: list[int]) -> int:
+        """Queue a committed write's ciphertext columns for ingest into
+        this group's existing pools (every modulus a past aggregate has
+        established). Returns how many were queued; with no pool for the
+        group yet there is nothing to convert against — the first
+        aggregate ingests as before (a cold fleet stays cold-path)."""
+        if not ciphers:
+            return 0
+        with self._lock:
+            if not any(g == gid for g, _ in self._pools):
+                return 0
+            q = self._pending.setdefault(gid, [])
+            room = self.max_pending - sum(
+                len(v) for v in self._pending.values()
+            )
+            take = ciphers[: max(0, room)]
+            q.extend(take)
+            dropped = len(ciphers) - len(take)
+            if dropped:  # bounded queue: a dropped entry just re-ingests
+                self._dropped_pending += dropped  # lazily at the next fold
+            return len(take)
+
+    def pending_ingest(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._pending.values())
+
+    def ingest_pending(self) -> int:
+        """Drain the write-ingest queue into the matching pools (run on a
+        worker thread, coalesced by the proxy exactly like folds).
+        Returns rows newly ingested across all pools."""
+        with self._lock:
+            batch, self._pending = self._pending, {}
+            pools = list(self._pools.items())
+        grew = 0
+        for gid, ciphers in batch.items():
+            for (g, _mod), pool in pools:
+                if g == gid:
+                    grew += pool.ingest(ciphers)
+        return grew
+
+    # ------------------------------------------------------------ evaluation
+
+    def fold_groups(
+        self, parts: list[tuple[str, list[int]]], modulus: int
+    ) -> int | None:
+        """prod over every group's operands mod `modulus` in ONE fused
+        dispatch, or None when any group's operand set cannot fit its
+        pool even after a reset (callers fall back to the per-group
+        marshaling paths)."""
+        import jax.numpy as jnp
+
+        parts = [(gid, ops) for gid, ops in parts if ops]
+        if not parts:
+            return 1 % modulus
+        ctx = ModCtx.make(modulus)
+        bufs, idxs, total = [], [], 0
+        for gid, ops in parts:
+            got = self.pool(gid, modulus).rows_for(ops)
+            if got is None:
+                return None
+            buf, idx = got
+            bufs.append(buf)
+            idxs.append(jnp.asarray(idx))
+            total += len(ops)
+        fn = _fused_fold_fn(ctx, len(parts), self.kernel, self.mesh, self.axis)
+        R = 1 << (bn.LIMB_BITS * ctx.L)
+        fix = jnp.asarray(
+            bn.int_to_limbs(pow(R % ctx.n, total, ctx.n), ctx.L)
+        )[None, :]
+        out = kprof.profiled(
+            "resident_fold",
+            lambda: fn(tuple(bufs), tuple(idxs), fix),
+            k=total, shards=len(parts),
+        )
+        return bn.limbs_to_int(np.asarray(out)[0])
+
+    def rows_for(self, gid: str, modulus: int, cs: list[int]):
+        """Gathered device rows (K, L) for `cs` from this group's pool —
+        the Prism MatVec operand path — or None when the set is wider
+        than the pool (callers marshal host ints as before)."""
+        import jax.numpy as jnp
+
+        if not cs:
+            return None
+        got = self.pool(gid, modulus).rows_for(cs)
+        if got is None:
+            return None
+        buf, idx = got
+        return jnp.take(buf, jnp.asarray(idx), axis=0)
+
+    # --------------------------------------------------------------- surface
+
+    def stats(self) -> dict:
+        """Per-pool view for GET /health."""
+        with self._lock:
+            pools = dict(self._pools)
+            pending = sum(len(v) for v in self._pending.values())
+        return {
+            "kernel": self.kernel,
+            "mesh_devices": (
+                int(self.mesh.devices.size) if self.mesh is not None else 1
+            ),
+            "pending_ingest": pending,
+            "pools": [
+                {"shard": gid or "-", "modulus_bits": mod.bit_length(),
+                 **pool.stats()}
+                for (gid, mod), pool in sorted(
+                    pools.items(), key=lambda kv: (kv[0][0], kv[0][1])
+                )
+            ],
+        }
+
+    def export_gauges(self, registry=metrics) -> None:
+        """Scrape-time gauges: dds_resident_{rows,bytes,hit_ratio,resets}
+        aggregated per shard label (pools for several moduli sum; the hit
+        ratio weights by operands served)."""
+        with self._lock:
+            pools = list(self._pools.items())
+        per_gid: dict[str, list] = {}
+        for (gid, _mod), pool in pools:
+            agg = per_gid.setdefault(gid or "-", [0, 0, 0, [0, 0, 0]])
+            agg[0] += pool.resident
+            agg[1] += pool.nbytes()
+            agg[2] += pool.resets
+            for i in range(3):
+                agg[3][i] += pool._served[i]
+        for gid, (rows, nbytes, resets, served) in per_gid.items():
+            registry.set("dds_resident_rows", rows, shard=gid,
+                         help="ciphertext rows resident per shard group")
+            registry.set("dds_resident_bytes", nbytes, shard=gid,
+                         help="device bytes pinned by resident pools per "
+                              "shard group")
+            registry.set("dds_resident_resets", resets, shard=gid,
+                         help="cumulative resident-pool capacity resets "
+                              "per shard group")
+            total = sum(served)
+            if total:
+                registry.set(
+                    "dds_resident_hit_ratio", round(served[0] / total, 4),
+                    shard=gid,
+                    help="fraction of fold operands served from resident "
+                         "rows per shard group",
+                )

@@ -408,6 +408,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             analytics_max_rows=cfg.analytics.max_rows,
             analytics_max_request_bytes=cfg.analytics.max_request_bytes,
             admission=cfg.admission,
+            resident=cfg.resident,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),
@@ -552,6 +553,7 @@ def proxy_config(cfg: DDSConfig, supervisor, ssl_server, ssl_client,
         analytics_max_rows=cfg.analytics.max_rows,
         analytics_max_request_bytes=cfg.analytics.max_request_bytes,
         admission=cfg.admission,
+        resident=cfg.resident,
         ssl_server_context=ssl_server,
         ssl_client_context=ssl_client,
     )

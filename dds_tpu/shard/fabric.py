@@ -69,6 +69,12 @@ class Constellation:
     secret: bytes = b""
     _build_kwargs: dict = field(default_factory=dict)
 
+    @property
+    def gids(self) -> list[str]:
+        """Group ids in construction order (the Lodestone resident
+        plane's pool registration order; see ShardRouter.group_ids)."""
+        return [g.gid for g in self.groups]
+
     def group(self, gid: str) -> ShardGroup:
         return next(g for g in self.groups if g.gid == gid)
 

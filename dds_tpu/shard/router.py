@@ -86,6 +86,13 @@ class ShardRouter:
     def owner(self, key: str) -> str:
         return self.shard_manager.current().owner(key)
 
+    def group_ids(self) -> list[str]:
+        """Current group ids in construction order — the stable
+        group -> mesh-slice assignment Lodestone's resident pools pin
+        their device placement by (split-born groups append, so existing
+        placements never move)."""
+        return list(self.clients)
+
     def _route(self, key: str) -> tuple[str, AbdClient]:
         gid = self.owner(key)
         client = self.clients.get(gid)

@@ -290,6 +290,33 @@ class AnalyticsConfig:
 
 
 @dataclass
+class ResidentConfig:
+    """Lodestone device-resident ciphertext plane (dds_tpu/resident):
+    per-shard-group content-addressed limb pools pinned in device memory,
+    write-path incremental ingest, and single-dispatch fused sharded
+    aggregates. HBM budget per group is rows x L x 4 bytes (L = limbs of
+    the aggregate modulus: 256 for 2048-bit Paillier n^2 -> 1 KiB/row);
+    past `max-rows` a pool resets and re-ingests on demand — never wrong
+    results, only a re-paid one-time ingest. DEPLOY.md "Resident
+    ciphertext plane (Lodestone)" is the runbook."""
+
+    enabled: bool = False
+    # per-pool capacity: start here, double up to max-rows, then reset
+    initial_rows: int = 256
+    max_rows: int = 65536
+    # smallest total aggregate width routed through the fused resident
+    # fold; 0 = the backend's own device crossover decides (a cpu-backend
+    # proxy with 0 sends every modular aggregate through the plane)
+    min_fold: int = 0
+    # write-path ingest: committed PutSet/AddElement/WriteElement
+    # ciphertexts ingest into this group's existing pools OFF the
+    # request's critical path, coalesced in ingest-window seconds — a
+    # warm fleet's first post-write aggregate pays zero ingest
+    write_ingest: bool = True
+    ingest_window: float = 0.005
+
+
+@dataclass
 class AdmissionConfig:
     """Bulwark overload control (dds_tpu/core/admission): per-tenant/
     per-priority-class token buckets and SLO-burn-driven load shedding at
@@ -413,6 +440,7 @@ class DDSConfig:
     shard: ShardConfig = field(default_factory=ShardConfig)
     analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    resident: ResidentConfig = field(default_factory=ResidentConfig)
     fabric: FabricConfig = field(default_factory=FabricConfig)
     debug: bool = False
 
@@ -464,6 +492,7 @@ _SUBSECTIONS = {
     ("DDSConfig", "shard"): ShardConfig,
     ("DDSConfig", "analytics"): AnalyticsConfig,
     ("DDSConfig", "admission"): AdmissionConfig,
+    ("DDSConfig", "resident"): ResidentConfig,
     ("DDSConfig", "fabric"): FabricConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
 }

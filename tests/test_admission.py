@@ -582,11 +582,29 @@ async def _drive_overload(admission: bool, tmp_path) -> dict:
                 status = -1
             probes.append((route, status))
 
+    # Event-driven run length (the PR 5 delay-storm treatment): the old
+    # fixed 1.6 s duration raced the shed ratchet against CI load — on a
+    # slow machine the SLO burn windows could still be filling when the
+    # drive stopped, and the "ratchet actually fired" assertion flaked.
+    # Subscribing to the controller's transition hook makes the signal
+    # explicit: the Bulwark run keeps driving (same open-loop schedule)
+    # until the shed transition has BEEN OBSERVED, up to a hard cap, then
+    # finishes the measurement window. The baseline run has no ratchet
+    # and keeps the original duration.
+    shed_seen = asyncio.Event()
+    if admission:
+        dep.server.admission.subscribe(
+            lambda rec: shed_seen.set() if rec["direction"] == "shed" else None
+        )
+    max_duration = duration * 4
+
     dep.trudy.trigger("delay")
     sched = random.Random(seed + 1)
     tasks, t0, t = [], time.perf_counter(), 0.0
     flood_at, probe_at = 0.0, 0.0
-    while t < duration:
+    while t < duration or (
+        admission and not shed_seen.is_set() and t < max_duration
+    ):
         now = time.perf_counter() - t0
         if now < t:
             await asyncio.sleep(t - now)

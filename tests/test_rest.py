@@ -525,6 +525,8 @@ def test_concurrent_small_sumalls_coalesce_into_one_dispatch():
     cross-request batching of r4 verdict #2."""
     from dds_tpu.models.backend import TpuBackend
 
+    import threading
+
     async def go():
         async with rest_stack() as (server, _, _):
             # each fold (K=6) is below the crossover (10) so requests enter
@@ -534,24 +536,43 @@ def test_concurrent_small_sumalls_coalesce_into_one_dispatch():
             calls = {"many": 0, "single": 0}
             orig_many = be.modmul_fold_many
             orig_res = be.modmul_fold_resident
-            be.modmul_fold_many = lambda folds, mod: (
-                calls.__setitem__("many", calls["many"] + 1) or orig_many(folds, mod)
-            )
-            be.modmul_fold_resident = lambda cs, mod: (
-                calls.__setitem__("single", calls["single"] + 1) or orig_res(cs, mod)
-            )
+            # Event-driven determinism (the old form raced the burst
+            # against a 2 ms window and hoped): the FIRST host fold — the
+            # direct path the first arrival takes — blocks on `coalesced`
+            # until a coalesced device dispatch has actually run, so the
+            # concurrency signal (folds in flight) deterministically holds
+            # open while the rest of the burst piles into the window. The
+            # drainer runs on the event loop, never behind this
+            # worker-thread wait, so the release is guaranteed; the wider
+            # window just keeps the burst in one drain cycle.
+            coalesced = threading.Event()
+
+            def gated_single(cs, mod):
+                calls["single"] += 1
+                if calls["single"] == 1:
+                    assert coalesced.wait(30), "coalesced dispatch never ran"
+                return orig_res(cs, mod)
+
+            def counting_many(folds, mod):
+                calls["many"] += 1
+                coalesced.set()
+                return orig_many(folds, mod)
+
+            be.modmul_fold_many = counting_many
+            be.modmul_fold_resident = gated_single
             server.backend = be
+            server.cfg.coalesce_window = 0.05
             pk = KEYS.psse.public
             vals = [rng.randrange(1 << 24) for _ in range(6)]
             for v in vals:
                 await call(server, "POST", "/PutSet", {"contents": [str(pk.encrypt(v))]})
 
             # 5 concurrent SumAlls: the first (no observed concurrency)
-            # takes the host path; later arrivals that see it in flight
-            # coalesce. Exact counts are timing-dependent (the first host
-            # fold may finish before a peer arrives), so assert the shape:
-            # at least one coalesced dispatch happened, every result is
-            # correct, and dispatches never exceeded request count.
+            # takes the host path and holds the in-flight signal; every
+            # later arrival sees it and coalesces into ONE device
+            # dispatch. Assert the shape: at least one coalesced dispatch
+            # happened, every result is correct, and dispatches never
+            # exceeded request count.
             results = await asyncio.gather(*(
                 call(server, "GET", f"/SumAll?position=0&nsqr={pk.nsquare}")
                 for _ in range(5)
