@@ -1,0 +1,107 @@
+"""Decrypt throughput: per-op host vs batched-CRT host vs Sanctum device.
+
+The decrypt half of the north star's "modular exponentiations behind
+encrypt, decrypt", measured across the three postures a deployment can
+run (DEPLOY.md "Secret-material trust boundary (Sanctum)"):
+
+- per-op:        `PaillierKey.decrypt` in a loop — the reference's
+                 `decryptFully` shape (one CRT pair per ciphertext on the
+                 per-key host plan);
+- batched host:  `decrypt_batch` on the host plan — shared per-key
+                 constants, native CIOS batch legs (the CRT-Paillier
+                 paper's precomputation-heavy host variant);
+- Sanctum device: `decrypt_batch(backend=SecretBackend(device=True))` —
+                 both half-width CRT legs fused into ONE batched device
+                 dispatch with the persistent compile cache bypassed.
+
+Every path is decrypt-VERIFIED against the known plaintexts before any
+timing: a fast wrong decrypt is not a result. One record per key size
+via common.emit(); vs_baseline = Sanctum device over per-op host.
+benchmarks/sentry.py --check validates the emitted `decrypt throughput`
+records (exit 2 on malformed).
+
+Usage: python -m benchmarks.decrypt_throughput
+           [--bits 1024,2048] [--b 256] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from benchmarks.common import best_of, emit
+
+
+def _metric(bits: int) -> str:
+    return f"decrypt throughput (CRT-Paillier, {bits}-bit)"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bits", default="1024,2048",
+                    help="comma-separated Paillier modulus sizes")
+    ap.add_argument("--b", type=int, default=256, help="ciphertext batch")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    from dds_tpu.bench_key import bench_paillier_key
+    from dds_tpu.sanctum import SecretBackend, plan_for
+
+    rows = []
+    B = args.b
+    for bits in [int(x) for x in str(args.bits).split(",") if x]:
+        key = bench_paillier_key(bits)
+        pk = key.public
+        rng = np.random.default_rng(17 + bits)
+        ms = [int(x) for x in rng.integers(0, 1 << 48, size=B)]
+        # a small rotating blind pool keeps ciphertext setup cheap at
+        # 2048 bits without weakening anything a DECRYPT bench measures
+        blinds = [pk.blind() for _ in range(16)]
+        cts = [pk.encrypt(m, rn=blinds[i % 16]) for i, m in enumerate(ms)]
+
+        dev = SecretBackend(device=True)
+        # decrypt-verify EVERY path before timing anything
+        host_slice = cts[: max(8, B // 32)]
+        assert [key.decrypt(c) for c in host_slice] == ms[: len(host_slice)], \
+            "per-op decrypt mismatch"
+        assert key.decrypt_batch(cts) == ms, "batched host decrypt mismatch"
+        assert key.decrypt_batch(cts, backend=dev, min_batch=1) == ms, \
+            "Sanctum device decrypt mismatch"
+
+        t_per_op = best_of(lambda: [key.decrypt(c) for c in host_slice],
+                           repeats=args.repeats)
+        per_op_ops = len(host_slice) / t_per_op
+
+        t_host = best_of(lambda: key.decrypt_batch(cts),
+                         repeats=args.repeats)
+        host_ops = B / t_host
+
+        # warm the device plan's compile outside the timed region (the
+        # per-key jit compiles exactly once per batch shape)
+        plan = plan_for(key, dev)
+        plan.decrypt_batch(cts)
+        t_dev = best_of(lambda: plan.decrypt_batch(cts),
+                        repeats=args.repeats)
+        dev_ops = B / t_dev
+
+        rows.append(emit(
+            _metric(bits),
+            dev_ops,
+            "ops/s",
+            dev_ops / per_op_ops,
+            bits=bits,
+            batch=B,
+            per_op_ops=round(per_op_ops, 1),
+            batched_host_ops=round(host_ops, 1),
+            sanctum_device_ops=round(dev_ops, 1),
+            batched_host_speedup=round(host_ops / per_op_ops, 2),
+            sanctum_speedup=round(dev_ops / per_op_ops, 2),
+            verified=True,
+        ))
+        key.scrub()  # bench keys are synthetic, but model the hygiene
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -16,9 +16,11 @@ bits, L=256) at batch B for:
 - DJN:     the 448-bit short-exponent host path (what per-op encryption
            uses today) — the honest host contender for bulk encryption.
 
-Also measures batched CRT DECRYPT (PaillierKey.decrypt_batch: two
-half-width shared-exponent modexp legs on the device) vs the per-op host
-decrypt, decrypt-verified.
+Also measures batched CRT DECRYPT (PaillierKey.decrypt_batch on the
+Sanctum device plane: both half-width CRT legs fused into one dispatch,
+secret moduli kept out of the shared caches) vs the per-op host decrypt,
+decrypt-verified. benchmarks/decrypt_throughput.py is the dedicated
+per-key-size decrypt sweep.
 
 vs_baseline = v2 sustained vs python pow.
 
@@ -91,18 +93,20 @@ def main(argv=None):
 
     v1_sus = sustained_device(lambda: pallas_mont.pow_mod(ctx, dev, n), R=args.pipelined)
 
-    # batched CRT decrypt: device path (two half-width shared-exponent
-    # modexp legs) vs per-op host decrypt, verified
-    from dds_tpu.models.backend import TpuBackend
+    # batched CRT decrypt: Sanctum device path (both half-width legs
+    # fused into one dispatch, secret moduli never in the shared caches
+    # — benchmarks/decrypt_throughput.py is the dedicated sweep) vs
+    # per-op host decrypt, verified
+    from dds_tpu.sanctum import SecretBackend, plan_for
 
-    be = TpuBackend(min_device_batch=0)
+    sb = SecretBackend(device=True)
     ms_plain = [int(x) for x in rng.integers(0, 1 << 48, size=B)]
     blinds = [pk.blind() for _ in range(32)]
     cts = [pk.encrypt(m, rn=blinds[i % 32]) for i, m in enumerate(ms_plain)]
-    got = key.decrypt_batch(cts, backend=be, min_batch=1)
+    got = key.decrypt_batch(cts, backend=sb, min_batch=1)
     assert got == ms_plain, "batched CRT decrypt mismatch"
-    dec_dev = best_of(lambda: key.decrypt_batch(cts, backend=be, min_batch=1),
-                      repeats=2)
+    dec_plan = plan_for(key, sb)  # warm plan; timing excludes its compile
+    dec_dev = best_of(lambda: dec_plan.decrypt_batch(cts), repeats=2)
     host_slice = cts[: max(8, B // 32)]
     dec_host = best_of(lambda: [key.decrypt(c) for c in host_slice], repeats=2)
     dec_dev_ops = B / dec_dev

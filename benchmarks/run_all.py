@@ -19,9 +19,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from benchmarks import (analytics_matvec, audit_cost, bft_sum, crossover,
-                            encrypt_modexp, mixed, multihost_load,
-                            overload_goodput, product, put_concurrency,
-                            resident_fold, shard_scaling, sweep)
+                            decrypt_throughput, encrypt_modexp, mixed,
+                            multihost_load, overload_goodput, product,
+                            put_concurrency, resident_fold, shard_scaling,
+                            sweep)
 
     rows = []
     if args.quick:
@@ -46,6 +47,9 @@ def main(argv=None):
             ["--k", "64", "--shards", "1,2", "--bits", "256",
              "--repeats", "2"]
         )
+        rows += decrypt_throughput.main(
+            ["--bits", "512", "--b", "48", "--repeats", "1"]
+        )
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -60,6 +64,7 @@ def main(argv=None):
         rows += overload_goodput.main([])
         rows += multihost_load.main([])
         rows += resident_fold.main([])
+        rows += decrypt_throughput.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

@@ -9,11 +9,12 @@ Modes (all emit one JSON line to stdout):
         Also parses any `shard scaling` (benchmarks/shard_scaling.py),
         `analytics matvec` (benchmarks/analytics_matvec.py),
         `overload goodput` (benchmarks/overload_goodput.py),
-        `multihost load` (benchmarks/multihost_load.py) and
-        `resident fold` (benchmarks/resident_fold.py) records in
-        benchmarks/results.json / results_quick.json so a malformed
-        scaling, analytics, overload, multihost or resident record is
-        caught by the same smoke.
+        `multihost load` (benchmarks/multihost_load.py),
+        `resident fold` (benchmarks/resident_fold.py) and
+        `decrypt throughput` (benchmarks/decrypt_throughput.py) records
+        in benchmarks/results.json / results_quick.json so a malformed
+        scaling, analytics, overload, multihost, resident or decrypt
+        record is caught by the same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -252,6 +253,41 @@ def _check_multihost_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_decrypt_records(root: str = REPO) -> dict:
+    """Validate `decrypt throughput` rows (benchmarks/decrypt_throughput
+    .py): positive ops/s value and a detail block naming the key size,
+    batch width, positive per-op / batched-host / Sanctum-device rates,
+    and verified=True — the decrypt-verified-before-timed contract the
+    record exists for. Same malformed contract as the other row
+    families: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("decrypt throughput")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("bits"), int) and detail["bits"] >= 256
+            and isinstance(detail.get("batch"), int) and detail["batch"] >= 1
+            and isinstance(detail.get("per_op_ops"), (int, float))
+            and detail["per_op_ops"] > 0
+            and isinstance(detail.get("batched_host_ops"), (int, float))
+            and detail["batched_host_ops"] > 0
+            and isinstance(detail.get("sanctum_device_ops"), (int, float))
+            and detail["sanctum_device_ops"] > 0
+            and detail.get("verified") is True
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed decrypt-throughput record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _load_fresh(path: str) -> dict:
     """A stats JSON: either the baseline schema or a bare kernels dict."""
     with open(path) as f:
@@ -296,6 +332,7 @@ def main(argv=None) -> int:
             overload = _check_overload_records()
             multihost = _check_multihost_records()
             resident = _check_resident_records()
+            decrypt = _check_decrypt_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -308,6 +345,7 @@ def main(argv=None) -> int:
             "overload_rows": overload["rows"],
             "multihost_rows": multihost["rows"],
             "resident_rows": resident["rows"],
+            "decrypt_rows": decrypt["rows"],
         }))
         return 0
 

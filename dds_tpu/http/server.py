@@ -14,7 +14,13 @@ TPU-first ideas the reference lacks:
   (core/quorum_client.py = `fetchSet`/`writeSet`, `:952-1050`).
 
 Like the reference, the proxy is computation-only: it sees ciphertexts and
-per-request public parameters (`nsqr`, `pubkey`), never keys.
+per-request public parameters (`nsqr`, `pubkey`), never keys. The other
+side of that boundary is enforced too: decryption — the only computation
+that touches key material — lives client-side on the Sanctum secret plane
+(`dds_tpu/sanctum`), which the shared `CryptoBackend`/`ModCtx` machinery
+this server compiles against can no longer carry even by accident
+(`PaillierKey.decrypt_batch` refuses public backends;
+`tools/secret_lint.py` rejects new flows statically).
 
 Reference quirks deliberately FIXED (SURVEY.md §7 "replicate or fix"):
 - `SumAll`/`MultAll`/`Search*` used `length-1 > position`, making the last

@@ -6,6 +6,16 @@ This is the `crypto.backend` switch from BASELINE.json: the query engine
 matching the reference trust model where `HomoAdd.sum`/`HomoMult.multiply`
 run proxy-side on ciphertexts (`dds/http/DDSRestServer.scala:385,423,479`).
 
+The "public parameters only" claim is load-bearing, not aspirational:
+every modulus handed to these backends lands in `ModCtx.make`'s
+process-wide cache and in executables the persistent compile cache
+serializes to disk, so SECRET moduli (the Paillier CRT legs p^2/q^2,
+RSA p/q) must never enter — the historical `decrypt_batch(backend=...)`
+routing that did exactly that was the ADVICE.md medium finding. Anything
+touching key material goes through `dds_tpu.sanctum` instead
+(`PaillierKey.decrypt_batch` now refuses these backends outright), and
+`tools/secret_lint.py` rejects new flows statically.
+
 The TPU backend converts ciphertext batches to (B, L) limb arrays and runs
 the tier-0 Montgomery kernels; a K-term aggregate costs ~1 batched modmul
 per term (tree reduction + one domain fixup). The CPU backend is the
@@ -23,7 +33,8 @@ from dds_tpu.ops.montgomery import ModCtx
 
 
 class CryptoBackend(Protocol):
-    """Ciphertext-domain modular arithmetic over public parameters."""
+    """Ciphertext-domain modular arithmetic over PUBLIC parameters only
+    (secret moduli: dds_tpu.sanctum — see the module docstring)."""
 
     name: str
 

@@ -31,13 +31,21 @@ class HomoProvider:
     # PaillierPublicKey.blind_fast): ~5x cheaper per ciphertext on the
     # client, standard variant. False = textbook full-width r^n.
     fast_blinding: bool = True
-    # Bulk-encryption accelerator (a models.backend.CryptoBackend): when
+    # Bulk-ENCRYPTION accelerator (a models.backend.CryptoBackend): when
     # set, precompute_psse_blinds routes the full-width r^n obfuscator
     # modexps through backend.powmod_batch (TPU/native) and PSSE encrypts
     # drain the pool — each ciphertext still gets an independent fresh
     # full-width obfuscator (textbook blinding, strictly stronger than
     # the DJN default), only the modexp moves off the host hot loop.
+    # Encrypt-only by construction: r^n works over public parameters; the
+    # decrypt legs carry secret CRT moduli and route through
+    # secret_backend below instead (never through this object).
     bulk_backend: object = None
+    # Sanctum handle (dds_tpu.sanctum.SecretBackend) for the PSSE decrypt
+    # CRT legs: None = the host-only default posture; a device-posture
+    # handle is the explicit `[crypto] secret-device` opt-in (DEPLOY.md
+    # "Secret-material trust boundary (Sanctum)").
+    secret_backend: object = None
     _blind_pool: list = field(default_factory=list, repr=False, compare=False)
 
     @staticmethod
@@ -112,13 +120,13 @@ class HomoProvider:
 
     def decrypt_rows(self, rows: list[list], until: int, schema: list[str],
                      min_batch: int = 64) -> list[list]:
-        """Bulk decrypt_row. With a bulk backend, all rows' PSSE columns
-        decrypt as ONE batched CRT modexp pair (PaillierKey.decrypt_batch
+        """Bulk decrypt_row. All rows' PSSE columns decrypt as ONE
+        batched CRT pass on the Sanctum plane (PaillierKey.decrypt_batch
         — the decrypt half of the reference's `decryptFully` hot loop,
-        `utils/SJHomoLibProvider.scala:89-101`); other schemes are cheap
-        per-op host work either way."""
-        if self.bulk_backend is None:
-            return [self.decrypt_row(r, until, schema) for r in rows]
+        `utils/SJHomoLibProvider.scala:89-101`): host-only unless this
+        provider carries a device-posture `secret_backend`. The PUBLIC
+        `bulk_backend` is encrypt-only and never sees the decrypt legs;
+        other schemes are cheap per-op host work either way."""
         cols = sorted(i for i, s in enumerate(schema[:until]) if s == "PSSE")
         cts = [int(r[i]) for r in rows for i in cols if i < len(r)]
         if len(cts) < min_batch:
@@ -126,7 +134,7 @@ class HomoProvider:
         k = self.keys.psse
         psse_cols = set(cols)
         plains = iter(
-            k.decrypt_batch(cts, backend=self.bulk_backend, min_batch=min_batch)
+            k.decrypt_batch(cts, backend=self.secret_backend, min_batch=min_batch)
         )
         out = []
         for r in rows:

@@ -66,9 +66,13 @@ class RsaMultKey:
                 return RsaMultKey(n=p * q, e=e, d=pow(e, -1, phi), p=p, q=q)
 
     def decrypt(self, c: int) -> int:
-        # CRT decryption: two half-size modexps.
-        mp = powmod(c % self.p, self.d % (self.p - 1), self.p)
-        mq = powmod(c % self.q, self.d % (self.q - 1), self.q)
+        # CRT decryption: two half-size modexps. CPython pow, NOT
+        # native.powmod: the native runtime memoizes per-modulus
+        # Montgomery consts module-wide, and p/q must not outlive this
+        # key object (the Sanctum rule, tools/secret_lint.py); per-op
+        # RSA decrypt is cheap host math either way.
+        mp = pow(c % self.p, self.d % (self.p - 1), self.p)
+        mq = pow(c % self.q, self.d % (self.q - 1), self.q)
         qinv = pow(self.q, -1, self.p)
         u = (mp - mq) * qinv % self.p
         return mq + u * self.q

@@ -13,7 +13,10 @@ Math (g = n + 1 throughout, so g^m = 1 + m*n mod n^2 needs no modexp):
     scalar    = c^k mod n^2
 
 Decryption uses the CRT split over p^2 / q^2 (two half-size modexps instead
-of one full-size), the standard Paillier speedup (cf. PAPERS.md CRT-Paillier).
+of one full-size), the standard Paillier speedup (cf. PAPERS.md
+CRT-Paillier), executed on the Sanctum secret-material plane
+(`dds_tpu/sanctum`): per-key precomputed constants, host-only by default,
+fused two-leg device dispatch behind the explicit `secret-device` opt-in.
 """
 
 from __future__ import annotations
@@ -44,7 +47,10 @@ _B0_CACHE: dict[int, int] = {}
 
 def _chunked_powmod(backend, bases: list[int], exp: int, mod: int) -> list[int]:
     """backend.powmod_batch in 8192-row chunks: bounds the (rows, L) limb
-    allocation per dispatch (~8 MB at L=256) for arbitrarily long batches."""
+    allocation per dispatch (~8 MB at L=256) for arbitrarily long batches.
+    PUBLIC moduli only (encrypt-side r^n): the backend caches per-modulus
+    contexts process-wide, so secret CRT moduli route through
+    dds_tpu.sanctum instead (tools/secret_lint.py enforces it)."""
     out: list[int] = []
     for i in range(0, len(bases), 8192):
         out.extend(backend.powmod_batch(bases[i : i + 8192], exp, mod))
@@ -253,40 +259,69 @@ class PaillierKey:
         return self.decrypt_batch([c])[0]
 
     def decrypt_batch(self, cs: list[int], backend=None, min_batch: int = 64) -> list[int]:
-        """Bulk CRT decrypt. Both CRT legs use SHARED exponents (p-1 and
-        q-1) over varying ciphertext residues — exactly
-        `CryptoBackend.powmod_batch`'s contract, so the two half-width
-        modexp batches (the entire decrypt cost) run as two device
-        dispatches; the L-function/recombination tail is cheap host math.
-        This is the "decrypt" half of the north-star's "modular
-        exponentiations behind encrypt, decrypt" (BASELINE.json), the
-        reference's `decryptFully` loop (`utils/SJHomoLibProvider.scala:
-        89-101`). Below `min_batch`, or with no backend, the per-op host
-        path."""
-        p, q, n = self.p, self.q, self.n
-        hp, hq, qinv = self._crt
-        p2, q2 = p * p, q * q
-        cps = [c % p2 for c in cs]
-        cqs = [c % q2 for c in cs]
-        if backend is not None and len(cs) >= min_batch:
-            xps = _chunked_powmod(backend, cps, p - 1, p2)
-            xqs = _chunked_powmod(backend, cqs, q - 1, q2)
-        else:
-            xps = [powmod(cp, p - 1, p2) for cp in cps]
-            xqs = [powmod(cq, q - 1, q2) for cq in cqs]
-        out = []
-        for xp, xq in zip(xps, xqs):
-            mp = (xp - 1) // p % p * hp % p
-            mq = (xq - 1) // q % q * hq % q
-            u = (mp - mq) * qinv % p
-            out.append((mq + u * q) % n)
-        return out
+        """Bulk CRT decrypt on the Sanctum secret-material plane.
+
+        Host-only by default: a per-key plan (`dds_tpu.sanctum`) carries
+        the precomputed constants of the batched-CRT optimization
+        (PAPERS.md CRT-Paillier) — p^2/q^2, the fixed exponents, the
+        native Montgomery consts — stored on THIS key object and
+        zeroized with it. This is the "decrypt" half of the north-star's
+        "modular exponentiations behind encrypt, decrypt"
+        (BASELINE.json), the reference's `decryptFully` loop
+        (`utils/SJHomoLibProvider.scala:89-101`).
+
+        `backend` accepts ONLY a Sanctum handle
+        (`dds_tpu.sanctum.SecretBackend`). A public-parameter
+        `CryptoBackend` raises: routing the secret CRT moduli through
+        `powmod_batch` parked p^2/q^2 in `ModCtx.make`'s process-wide
+        cache and baked them into persistently-cached executables — p is
+        recoverable from p^2 by isqrt (ADVICE.md medium finding; DEPLOY.md
+        "Secret-material trust boundary (Sanctum)"). With a
+        device-posture handle and >= `min_batch` ciphertexts, both CRT
+        legs run as ONE fused batched dispatch (stacked p^2/q^2 lanes,
+        per-key exponent digits); below `min_batch` the host plan wins on
+        dispatch latency, as for every small batch."""
+        from dds_tpu import sanctum
+
+        if backend is not None and not sanctum.is_secret_backend(backend):
+            raise ValueError(
+                "decrypt_batch no longer accepts public-parameter "
+                f"CryptoBackends ({getattr(backend, 'name', type(backend).__name__)!r}): "
+                "the CRT legs' moduli p^2/q^2 are secrets and must not "
+                "transit ModCtx.make's shared cache or the persistent "
+                "compile cache (ADVICE.md). Pass "
+                "dds_tpu.sanctum.SecretBackend(device=True) for the "
+                "device opt-in, or None for the host-only default."
+            )
+        if (
+            backend is not None
+            and getattr(backend, "device", False)
+            and len(cs) >= min_batch
+        ):
+            return sanctum.plan_for(self, backend).decrypt_batch(cs)
+        return sanctum.plan_for(self).decrypt_batch(cs)
+
+    def scrub(self) -> None:
+        """Eagerly close/zeroize every derived-secret cache this key
+        accumulated: the `_crt` constants and any Sanctum plans
+        (host consts, device limb arrays, per-plan compiled-fn caches).
+        The p/q/n fields themselves are immutable ints — scrub() bounds
+        the lifetime of the DERIVED copies; dropping the key object
+        finishes the job (a weakref finalizer zeroizes the plans even
+        without an explicit scrub)."""
+        from dds_tpu import sanctum
+
+        sanctum.scrub_key(self)
 
     def to_signed(self, m: int) -> int:
-        """Map the upper half of Z_n back to negative ints — the ONE
-        signed-range convention, shared by decrypt_signed and the
-        facade's batched row decryption."""
-        return m - self.n if m > self.n // 2 else m
+        """Map Z_n residues onto the signed range (-n/2, n/2] — the ONE
+        signed convention, shared by decrypt_signed, the facade's batched
+        row decryption, and the analytics row decoder, and exactly the
+        decodability contract `matvec_encode` documents. Pinned as
+        `2*m <= n` (keep positive) rather than the earlier floor-division
+        comparison, which reads ambiguously at the midpoint under
+        even-modulus conventions: (-n/2, n/2] keeps m = n/2 positive."""
+        return m if 2 * m <= self.n else m - self.n
 
     def decrypt_signed(self, c: int) -> int:
         """Decrypt, mapping the upper half of Z_n back to negative ints."""

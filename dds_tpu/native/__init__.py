@@ -106,13 +106,23 @@ def _unwords(a: np.ndarray) -> int:
     return int.from_bytes(a.tobytes(), "little")
 
 
-@functools.lru_cache(maxsize=256)
-def _mont_consts(n: int) -> tuple[int, int, int]:
-    """(L, n0inv, R2 mod n) for odd modulus n."""
+def mont_consts_uncached(n: int) -> tuple[int, int, int]:
+    """(L, n0inv, R2 mod n) for odd modulus n — computed fresh, cached
+    NOWHERE in this module. The entry point for callers that manage the
+    lifetime of SECRET moduli themselves (dds_tpu.sanctum holds these per
+    key and drops them with it); the lru-cached `_mont_consts` below must
+    only ever see public moduli, because its entries outlive every key
+    object (tools/secret_lint.py enforces the split)."""
     L = -(-n.bit_length() // 64)
     R = 1 << (64 * L)
     n0inv = (-pow(n % (1 << 64), -1, 1 << 64)) % (1 << 64)
     return L, n0inv, (R * R) % n
+
+
+# public-parameter consts cache: bounds repeat host-side Montgomery setup
+# for the handful of moduli a process serves (n, n^2, RSA n). Secret
+# moduli route through mont_consts_uncached — see its docstring.
+_mont_consts = functools.lru_cache(maxsize=256)(mont_consts_uncached)
 
 
 def _usable(n: int) -> bool:
@@ -139,21 +149,44 @@ def powmod(base: int, exp: int, mod: int) -> int:
     return _unwords(out)
 
 
-def powmod_batch(bases: list[int], exp: int, mod: int) -> list[int]:
-    """Shared-exponent batch modexp (GIL released for the whole batch)."""
-    if exp < 0 or not _usable(mod):
-        return [pow(b, exp, mod) for b in bases]
-    if exp == 0:
-        return [1 % mod] * len(bases)
-    if not bases:
-        return []
-    L, n0, r2 = _mont_consts(mod)
+def _exp_batch_impl(bases: list[int], exp: int, mod: int,
+                    consts: tuple[int, int, int]) -> list[int]:
+    L, n0, r2 = consts
     ew, nibbles = _exp_words(exp)
     bw = np.stack([_words(b % mod, L) for b in bases])
     out = np.zeros_like(bw)
     _LIB.ddsbn_exp_batch(L, _words(mod, L), n0, _words(r2, L),
                          np.ascontiguousarray(bw), len(bases), ew, nibbles, out)
     return [_unwords(out[i]) for i in range(len(bases))]
+
+
+def powmod_batch(bases: list[int], exp: int, mod: int) -> list[int]:
+    """Shared-exponent batch modexp (GIL released for the whole batch).
+    PUBLIC moduli only: consts are memoized module-wide (see
+    mont_consts_uncached for the secret-material contract)."""
+    if exp < 0 or not _usable(mod):
+        return [pow(b, exp, mod) for b in bases]
+    if exp == 0:
+        return [1 % mod] * len(bases)
+    if not bases:
+        return []
+    return _exp_batch_impl(bases, exp, mod, _mont_consts(mod))
+
+
+def powmod_batch_with_consts(bases: list[int], exp: int, mod: int,
+                             consts: tuple[int, int, int] | None) -> list[int]:
+    """powmod_batch with CALLER-HELD Montgomery consts (from
+    mont_consts_uncached): nothing about `mod` is retained in this module
+    after the call — the host fast path for secret CRT moduli. `consts`
+    None (or an unusable modulus / toolchain-less host) falls back to
+    python pow, which also retains nothing."""
+    if consts is None or exp < 0 or not _usable(mod):
+        return [pow(b, exp, mod) for b in bases]
+    if exp == 0:
+        return [1 % mod] * len(bases)
+    if not bases:
+        return []
+    return _exp_batch_impl(bases, exp, mod, consts)
 
 
 def fold(cs: list[int], mod: int) -> int:

@@ -52,6 +52,34 @@ def analytics_max_rows(default: int = 256) -> int:
     return rows
 
 
+def secret_device(default: bool = False) -> bool:
+    """Sanctum device opt-in: run the secret-material CRT decrypt legs
+    as a fused batched device dispatch instead of the host-only default
+    (DEPLOY.md "Secret-material trust boundary (Sanctum)").
+    DDS_SECRET_DEVICE when set, else `default` (the `[crypto]
+    secret-device` config value flows in here). Validated the same loud
+    way DDS_PROD_TB is — a typo fails at provider construction with an
+    actionable message, because an operator who believes they opted
+    IN (or OUT) of device residency for key material must never be
+    silently wrong about it."""
+    env = os.environ.get("DDS_SECRET_DEVICE", "").strip().lower()
+    if not env:
+        if not isinstance(default, bool):
+            raise ValueError(
+                "[crypto] secret-device must be a boolean, got "
+                f"{default!r}"
+            )
+        return default
+    if env in ("1", "true", "on", "yes"):
+        return True
+    if env in ("0", "false", "off", "no"):
+        return False
+    raise ValueError(
+        f"unknown DDS_SECRET_DEVICE value {env!r} (use 1/true/on/yes or "
+        "0/false/off/no)"
+    )
+
+
 def prod_tb() -> int | None:
     """DDS_PROD_TB: lane-tile override for the MXU product kernel, or None
     when unset. Validated HERE — int, positive, multiple of the 128-lane

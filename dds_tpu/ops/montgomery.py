@@ -33,6 +33,8 @@ one O(L) scan and conditionally reduced below n.
 from __future__ import annotations
 
 import functools
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -110,6 +112,75 @@ def _mont_exp_raw(base, exp_digits, one_mont, N, n0inv):
     return r
 
 
+def _mont_mul_rowmod_raw(a, b, N, n0inv):
+    """CIOS Montgomery multiply with PER-ROW moduli.
+
+    a, b: (B, L) canonical; N: (B, L) — each row's own modulus limbs;
+    n0inv: (B,) per-row Montgomery constants. Returns (B, L) canonical,
+    row i being a[i] * b[i] * R^-1 mod N[i]. The per-row twin of
+    `_mont_mul_raw`: every step is already elementwise over the batch
+    axis, so a per-row modulus costs nothing extra — it exists so the
+    Sanctum secret-material plane (dds_tpu/sanctum) can run both CRT
+    decrypt legs (moduli p^2 and q^2) as ONE stacked dispatch. The
+    carry-bound argument at the top of this module holds per row
+    unchanged.
+    """
+    B, L = a.shape
+
+    def step(t, ai):
+        p = ai[:, None] * b                       # (B, L) < 2^32
+        t = t.at[:, :-1].add(p & LIMB_MASK)
+        t = t.at[:, 1:].add(p >> LIMB_BITS)
+        m = (t[:, 0] * n0inv) & LIMB_MASK         # (B,)
+        q = m[:, None] * N
+        t = t.at[:, :-1].add(q & LIMB_MASK)
+        t = t.at[:, 1:].add(q >> LIMB_BITS)
+        carry0 = t[:, 0] >> LIMB_BITS
+        t = jnp.concatenate([t[:, 1:], jnp.zeros((B, 1), jnp.uint32)], axis=1)
+        t = t.at[:, 0].add(carry0)
+        c = t[:, :-1] >> LIMB_BITS
+        t = t.at[:, :-1].set(t[:, :-1] & LIMB_MASK)
+        t = t.at[:, 1:].add(c)
+        return t, None
+
+    t0 = jnp.zeros((B, L + 1), jnp.uint32)
+    t, _ = jax.lax.scan(step, t0, a.T)
+    t, carry = normalize(t)
+    del carry
+    N_ext = jnp.concatenate([N, jnp.zeros((B, 1), jnp.uint32)], axis=1)
+    t = cond_sub(t, N_ext)
+    return t[:, :-1]
+
+
+def _mont_exp_rowdigits_raw(base, exp_digits, one_mont, N, n0inv):
+    """Per-row-exponent 4-bit-window ladder over per-row moduli.
+
+    base: (B, L) Montgomery domain; exp_digits: (E, B) uint32 MSB-first
+    4-bit digits — row b's exponent in column b (pad shorter exponents
+    with LEADING zero digits: a zero digit squares the running identity
+    and multiplies by table[0] = 1, a no-op); one_mont/N: (B, L);
+    n0inv: (B,). Result stays in the Montgomery domain, like
+    `_mont_exp_raw`.
+    """
+    mul = lambda x, y: _mont_mul_rowmod_raw(x, y, N, n0inv)
+
+    tab = [one_mont, base]
+    for _ in range(2, 1 << WINDOW):
+        tab.append(mul(tab[-1], base))
+    table = jnp.stack(tab, axis=0)                # (16, B, L)
+
+    def step(r, digit):                           # digit: (B,)
+        for _ in range(WINDOW):
+            r = mul(r, r)
+        sel = jnp.take_along_axis(
+            table, digit.astype(jnp.int32)[None, :, None], axis=0
+        )[0]                                      # (B, L): table[digit[b], b]
+        return mul(r, sel), None
+
+    r, _ = jax.lax.scan(step, one_mont, exp_digits)
+    return r
+
+
 def _tree_reduce_raw(cs, N, n0inv):
     """Binary-tree modular product of cs (K, L), K a power of two.
 
@@ -133,6 +204,25 @@ def _exp_to_digits(exp: int) -> np.ndarray:
     )
 
 
+# ModCtx.make's shared cache: an explicit bounded LRU rather than a
+# functools.lru_cache so its CONTENTS are inspectable — the Sanctum
+# key-hygiene regression test (tests/test_sanctum.py) asserts no
+# secret-derived modulus ever lands here, and tools/secret_lint.py
+# treats flows into this cache as violations. Secret CRT moduli must
+# use dds_tpu.sanctum's per-key SecretModCtx instead: entries here
+# outlive every key object.
+_CTX_CACHE: "OrderedDict[tuple[int, int | None], ModCtx]" = OrderedDict()
+_CTX_CACHE_MAX = 64
+_CTX_CACHE_LOCK = threading.Lock()
+
+
+def cached_moduli() -> list[int]:
+    """The moduli currently held by ModCtx.make's shared cache (hygiene
+    introspection; see _CTX_CACHE above)."""
+    with _CTX_CACHE_LOCK:
+        return [k[0] for k in _CTX_CACHE]
+
+
 @dataclass(frozen=True, eq=False)
 class ModCtx:
     """Precomputed Montgomery context for one odd modulus n.
@@ -150,8 +240,11 @@ class ModCtx:
     one_mont: np.ndarray = field(repr=False)
 
     @staticmethod
-    @functools.lru_cache(maxsize=64)
-    def make(n: int, L: int | None = None) -> "ModCtx":
+    def build(n: int, L: int | None = None) -> "ModCtx":
+        """An UNCACHED context. Public-parameter callers want `make`;
+        this exists for contexts whose lifetime a caller manages itself
+        (the Sanctum secret plane builds its per-key twins from the same
+        constants without touching the shared cache)."""
         if n % 2 == 0:
             raise ValueError("Montgomery modulus must be odd")
         if L is None:
@@ -168,6 +261,29 @@ class ModCtx:
             R2=int_to_limbs((R * R) % n, L),
             one_mont=int_to_limbs(R % n, L),
         )
+
+    @staticmethod
+    def make(n: int, L: int | None = None) -> "ModCtx":
+        """The cached entry point for PUBLIC moduli (n, n^2, RSA n): one
+        shared context (and one set of compiled kernels hanging off it)
+        per modulus, process-wide. Never call with secret-derived moduli
+        — entries outlive keys; dds_tpu.sanctum owns that case."""
+        key = (n, L)
+        with _CTX_CACHE_LOCK:
+            ctx = _CTX_CACHE.get(key)
+            if ctx is not None:
+                _CTX_CACHE.move_to_end(key)
+                return ctx
+        ctx = ModCtx.build(n, L)
+        with _CTX_CACHE_LOCK:
+            cached = _CTX_CACHE.get(key)
+            if cached is not None:  # lost a benign build race: keep the first
+                _CTX_CACHE.move_to_end(key)
+                return cached
+            while len(_CTX_CACHE) >= _CTX_CACHE_MAX:
+                _CTX_CACHE.popitem(last=False)
+            _CTX_CACHE[key] = ctx
+        return ctx
 
     # -- jitted entry points (cached per context) ---------------------------
 

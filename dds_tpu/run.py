@@ -704,7 +704,22 @@ def load_provider(cfg: DDSConfig) -> HomoProvider:
         from dds_tpu.models.backend import get_backend
 
         bulk = get_backend(c.bulk_encrypt_backend)
-    return HomoProvider(keys, fast_blinding=c.fast_blinding, bulk_backend=bulk)
+    # Sanctum posture for the decrypt CRT legs: host-only unless the
+    # operator explicitly opted in ([crypto] secret-device, or the
+    # DDS_SECRET_DEVICE twin — validated loudly HERE, at construction,
+    # per the DDS_PROD_TB pattern, so a typo'd opt-in/out never silently
+    # changes where key material computes).
+    from dds_tpu.ops.flags import secret_device
+
+    secret = None
+    if secret_device(default=cfg.crypto.secret_device):
+        from dds_tpu.sanctum import SecretBackend
+
+        secret = SecretBackend(device=True)
+    return HomoProvider(
+        keys, fast_blinding=c.fast_blinding, bulk_backend=bulk,
+        secret_backend=secret,
+    )
 
 
 async def run_workload(dep: Deployment, provider: HomoProvider | None = None,

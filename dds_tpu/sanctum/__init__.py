@@ -1,0 +1,46 @@
+"""Sanctum — the secret-material execution plane.
+
+Everything that computes WITH private-key material (the CRT legs of
+Paillier decryption: moduli p^2/q^2, exponents p-1/q-1) runs here, under
+a memory-residency discipline the public-parameter hot path deliberately
+does not have:
+
+- per-KEY contexts and precomputed constants, stored on the key object
+  itself (the ``_crt`` cached_property pattern) — never in
+  ``ModCtx.make``'s process-wide cache or ``dds_tpu.native``'s
+  module-level consts cache, whose entries outlive every key;
+- host-only by default; an explicit device opt-in (``[crypto]
+  secret-device`` / ``DDS_SECRET_DEVICE``) runs both CRT legs as one
+  fused batched dispatch with every secret value passed as a traced
+  ARGUMENT (nothing baked into executables) and the persistent JAX
+  compilation cache bypassed for those compiles;
+- explicit ``close()``/``PaillierKey.scrub()`` plus a ``weakref``
+  finalizer that zeroizes host copies when the key object is dropped.
+
+``tools/secret_lint.py`` (run as a tier-1 test) statically rejects any
+new flow of key-derived values into the shared caches outside this
+package. DEPLOY.md "Secret-material trust boundary (Sanctum)" is the
+operator-facing contract; HEAAN-demystified (arxiv 2003.04510) and the
+CRT-Paillier optimization paper (arxiv 2506.17935) are the structural
+and numerical references.
+
+This module is jax-free to import: host-only consumers (the default
+posture) never pay the device stack; ``sanctum.device`` loads lazily on
+first device-plan use.
+"""
+
+from dds_tpu.sanctum.plane import (
+    HostCrtPlan,
+    SecretBackend,
+    is_secret_backend,
+    plan_for,
+    scrub_key,
+)
+
+__all__ = [
+    "HostCrtPlan",
+    "SecretBackend",
+    "is_secret_backend",
+    "plan_for",
+    "scrub_key",
+]

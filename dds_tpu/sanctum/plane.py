@@ -1,0 +1,179 @@
+"""Sanctum host plane: per-key CRT decrypt plans and the backend handle.
+
+jax-free by design — the host-only default posture must not import the
+device stack. The device leg lives in ``sanctum.device`` and is imported
+lazily by ``plan_for`` only when a caller opts in.
+
+Lifetime contract (the point of this module): every derived secret —
+CRT moduli p^2/q^2, exponents p-1/q-1, Montgomery constants for them —
+lives on a plan object reachable ONLY from the key that owns it. A
+``weakref.finalize`` zeroizes/drops host copies when the key object is
+garbage-collected; ``PaillierKey.scrub()`` does it eagerly. Nothing here
+writes into ``ModCtx.make``'s shared cache, ``dds_tpu.native``'s
+module-level consts cache, or any other module-level store (enforced
+statically by ``tools/secret_lint.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+# host batch chunk: bounds the (rows, words) allocation per native call,
+# mirroring models/paillier._chunked_powmod's sizing for the public path
+_HOST_CHUNK = 8192
+
+_PLANS_ATTR = "_sanctum_plans"
+_PLANS_LOCK = threading.Lock()
+
+
+class SecretBackend:
+    """Policy handle for where secret-material computation runs.
+
+    ``device=False`` (the default posture) keeps both CRT legs on the
+    host; ``device=True`` is the explicit opt-in that fuses them into
+    one batched device dispatch (see ``sanctum.device`` for what the
+    opt-in exposes and how the persistent compile cache is bypassed).
+    This is NOT a ``models.backend.CryptoBackend`` — it has no
+    ``powmod_batch`` on purpose: secret moduli must never be expressible
+    through the public-parameter interface again.
+    """
+
+    name = "sanctum"
+    # duck-type marker PaillierKey.decrypt_batch validates: public
+    # CryptoBackends don't carry it, so passing one raises loudly
+    secret_plane = True
+
+    def __init__(self, device: bool = False, chunk: int = 4096):
+        self.device = bool(device)
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.chunk = int(chunk)
+
+
+def is_secret_backend(obj) -> bool:
+    """True for objects allowed to carry secret-material computation
+    (the ``secret_plane`` marker — see SecretBackend)."""
+    return getattr(obj, "secret_plane", False) is True
+
+
+def _crt_recombine(xps, xqs, p, q, n, hp, hq, qinv):
+    """The L-function + CRT recombination tail shared by the host and
+    device plans: m_p = L_p(x_p) h_p, m_q = L_q(x_q) h_q, then Garner.
+    Cheap host math next to the modexp legs; one body so the two plans
+    cannot drift."""
+    out = []
+    for xp, xq in zip(xps, xqs):
+        mp = (xp - 1) // p % p * hp % p
+        mq = (xq - 1) // q % q * hq % q
+        u = (mp - mq) * qinv % p
+        out.append((mq + u * q) % n)
+    return out
+
+
+class HostCrtPlan:
+    """Per-key batched CRT decrypt on the host.
+
+    Precomputes once per key what the per-op path recomputed per call
+    (p^2, q^2, the fixed exponents, and — when the native runtime is
+    available — the Montgomery consts for both legs via
+    ``native.mont_consts_uncached``, passed back in explicitly so the
+    module-level consts cache never sees a secret modulus). Falls back
+    to python ``pow`` without the native toolchain; results are
+    bit-for-bit either way.
+    """
+
+    def __init__(self, key):
+        p, q, n = key.p, key.q, key.n
+        hp, hq, qinv = key._crt
+        self.p, self.q, self.n = p, q, n
+        self.p2, self.q2 = p * p, q * q
+        self.hp, self.hq, self.qinv = hp, hq, qinv
+        from dds_tpu import native
+
+        self._consts_p = self._consts_q = None
+        if native.available():
+            self._consts_p = native.mont_consts_uncached(self.p2)
+            self._consts_q = native.mont_consts_uncached(self.q2)
+        self.closed = False
+
+    def decrypt_batch(self, cs: list[int]) -> list[int]:
+        if self.closed:
+            raise RuntimeError("sanctum plan is closed (key scrubbed)")
+        from dds_tpu.native import powmod_batch_with_consts
+
+        xps: list[int] = []
+        xqs: list[int] = []
+        for i in range(0, len(cs), _HOST_CHUNK):
+            chunk = cs[i : i + _HOST_CHUNK]
+            xps.extend(powmod_batch_with_consts(
+                [c % self.p2 for c in chunk], self.p - 1, self.p2,
+                self._consts_p,
+            ))
+            xqs.extend(powmod_batch_with_consts(
+                [c % self.q2 for c in chunk], self.q - 1, self.q2,
+                self._consts_q,
+            ))
+        return _crt_recombine(
+            xps, xqs, self.p, self.q, self.n, self.hp, self.hq, self.qinv
+        )
+
+    def close(self) -> None:
+        """Drop the derived secrets. Python ints are immutable — there is
+        nothing to overwrite in place — so 'zeroization' here means
+        unlinking every reference this plan holds; the device plan
+        additionally zero-fills its numpy copies."""
+        self.p = self.q = self.n = self.p2 = self.q2 = 0
+        self.hp = self.hq = self.qinv = 0
+        self._consts_p = self._consts_q = None
+        self.closed = True
+
+
+def plan_for(key, backend: SecretBackend | None = None):
+    """The per-key Sanctum plan for `backend`'s posture (None or
+    ``device=False`` → HostCrtPlan; ``device=True`` → the fused device
+    plan). Created once per (key, posture) and stored in the key's own
+    ``__dict__`` — the ``_crt`` cached_property pattern, so the plan
+    lives exactly as long as the key — with a ``weakref.finalize`` that
+    closes (zeroizes) it when the key is collected without an explicit
+    ``scrub()``."""
+    want_device = backend is not None and getattr(backend, "device", False)
+    plans = key.__dict__.get(_PLANS_ATTR)
+    if plans is None:
+        with _PLANS_LOCK:
+            plans = key.__dict__.get(_PLANS_ATTR)
+            if plans is None:
+                plans = {}
+                # frozen dataclass: write the instance dict directly,
+                # exactly like functools.cached_property does
+                key.__dict__[_PLANS_ATTR] = plans
+    tag = "device" if want_device else "host"
+    plan = plans.get(tag)
+    if plan is None:
+        with _PLANS_LOCK:
+            plan = plans.get(tag)
+            if plan is None:
+                if want_device:
+                    from dds_tpu.sanctum.device import SecretDevicePlan
+
+                    plan = SecretDevicePlan(
+                        key, chunk=getattr(backend, "chunk", 4096)
+                    )
+                else:
+                    plan = HostCrtPlan(key)
+                # NOTE: plan must hold no reference back to `key` (it
+                # copies the ints it needs) or the finalizer could keep
+                # the key alive / never fire
+                weakref.finalize(key, plan.close)
+                plans[tag] = plan
+    return plan
+
+
+def scrub_key(key) -> None:
+    """Eagerly close every Sanctum plan a key accumulated and drop its
+    cached CRT constants; the backing store for ``PaillierKey.scrub``."""
+    with _PLANS_LOCK:
+        plans = key.__dict__.pop(_PLANS_ATTR, None)
+    for plan in (plans or {}).values():
+        plan.close()
+    key.__dict__.pop("_crt", None)

@@ -370,6 +370,24 @@ class AdmissionConfig:
 
 
 @dataclass
+class CryptoConfig:
+    """Sanctum secret-material execution plane (dds_tpu/sanctum): where
+    computation that TOUCHES private-key material runs — today the CRT
+    legs of batched Paillier decryption (client-side verification and
+    `HomoProvider.decrypt_rows`). Host-only by default. `secret-device =
+    true` is the explicit opt-in that fuses both CRT legs into one
+    batched device dispatch: faster bulk decryption, in exchange for
+    transient HBM residency of p^2/q^2-derived values (executables stay
+    secret-free — constants ride as traced arguments — and the
+    persistent compile cache is bypassed for those compiles). The
+    DDS_SECRET_DEVICE env twin overrides; both are validated loudly by
+    ops/flags.secret_device. DEPLOY.md "Secret-material trust boundary
+    (Sanctum)" is the runbook."""
+
+    secret_device: bool = False
+
+
+@dataclass
 class FabricConfig:
     """Meridian multi-host shard fabric (dds_tpu/fabric): spread a
     Constellation's S quorum groups plus separate proxies across N OS
@@ -442,6 +460,7 @@ class DDSConfig:
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     resident: ResidentConfig = field(default_factory=ResidentConfig)
     fabric: FabricConfig = field(default_factory=FabricConfig)
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
     debug: bool = False
 
     # ------------------------------------------------------------- loading
@@ -494,5 +513,6 @@ _SUBSECTIONS = {
     ("DDSConfig", "admission"): AdmissionConfig,
     ("DDSConfig", "resident"): ResidentConfig,
     ("DDSConfig", "fabric"): FabricConfig,
+    ("DDSConfig", "crypto"): CryptoConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
 }

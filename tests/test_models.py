@@ -207,40 +207,55 @@ def test_provider_blind_pool_feeds_psse_encrypts():
     assert KEYS.psse.decrypt(int(prov2.encrypt(42, "PSSE"))) == 42
 
 
-def test_paillier_decrypt_batch_through_tpu_backend():
-    """Batched CRT decrypt: both half-width modexp legs route through
-    powmod_batch (shared exponents p-1/q-1) and every plaintext matches
-    the per-op decrypt — the decrypt half of the north star's
-    "modexps behind encrypt, decrypt"."""
+def test_paillier_decrypt_batch_through_sanctum():
+    """Batched CRT decrypt routes through the Sanctum secret plane: the
+    fused two-leg device dispatch matches the per-op host decrypt
+    bit-for-bit, and the public-parameter backends the old contract
+    accepted are refused loudly (the ADVICE.md medium finding, closed at
+    the source)."""
+    from dds_tpu.sanctum import SecretBackend
+
     pk = KEYS.psse.public
-    be = get_backend("tpu")
-    be.min_device_batch = 0
     ms = [rng.randrange(1 << 40) for _ in range(7)]
     cts = [pk.encrypt(m) for m in ms]
-    assert KEYS.psse.decrypt_batch(cts, backend=be, min_batch=1) == ms
-    # host path (below min_batch) agrees
-    assert KEYS.psse.decrypt_batch(cts, backend=be, min_batch=100) == ms
+    dev = SecretBackend(device=True)
+    assert KEYS.psse.decrypt_batch(cts, backend=dev, min_batch=1) == ms
+    # host plan (below min_batch, or no backend) agrees
+    assert KEYS.psse.decrypt_batch(cts, backend=dev, min_batch=100) == ms
     assert KEYS.psse.decrypt_batch(cts) == ms
+    # a public CryptoBackend can no longer carry the secret CRT legs
+    with pytest.raises(ValueError, match="public-parameter"):
+        KEYS.psse.decrypt_batch(cts, backend=get_backend("tpu"), min_batch=1)
+    with pytest.raises(ValueError, match="public-parameter"):
+        KEYS.psse.decrypt_batch(cts, backend=get_backend("cpu"))
 
 
 def test_provider_decrypt_rows_batches_psse_columns():
-    """decrypt_rows with a bulk backend batches every PSSE column through
-    one CRT modexp pair and matches per-row decrypt_row exactly (incl.
-    the signed mapping for negative values)."""
+    """decrypt_rows batches every PSSE column through one Sanctum CRT
+    pass and matches per-row decrypt_row exactly (incl. the signed
+    mapping for negative values) — and the PUBLIC bulk backend, now
+    encrypt-only, is never consulted on the decrypt path."""
+    from dds_tpu.sanctum import SecretBackend
+
     be = get_backend("tpu")
     be.min_device_batch = 0
-    prov = HomoProvider(KEYS, bulk_backend=be)
-    schema = ["OPE", "CHE", "PSSE", "PSSE"]
-    rows_plain = [[i, f"u-{i}", i * 1000, -i] for i in range(6)]
-    rows_enc = [prov.encrypt_row(r + [f"x{i}"], 4, schema)
-                for i, r in enumerate(rows_plain)]
+    prov = HomoProvider(
+        KEYS, bulk_backend=be, secret_backend=SecretBackend(device=True)
+    )
+    # numeric schemes only (no CHE/None): the behavior under test is
+    # PSSE batching, and this keeps the test running in AES-less envs
+    schema = ["OPE", "MSE", "PSSE", "PSSE"]
+    rows_plain = [[i, i * 7 + 1, i * 1000, -i] for i in range(6)]
+    rows_enc = [prov.encrypt_row(list(r), 4, schema) for r in rows_plain]
     calls = {"n": 0}
     orig = be.powmod_batch
     be.powmod_batch = lambda b, e, m: calls.__setitem__("n", calls["n"] + 1) or orig(b, e, m)
     got = prov.decrypt_rows(rows_enc, 4, schema, min_batch=1)
-    assert calls["n"] == 2  # the two CRT legs, once for ALL rows/columns
+    assert calls["n"] == 0  # secret CRT legs never touch the public backend
     want = [prov.decrypt_row(r, 4, schema) for r in rows_enc]
     assert got == want
     assert [g[:4] for g in got] == rows_plain
-    # without a backend: identical results through the per-row path
+    # without any backend: identical results through the host-only plane
     assert HomoProvider(KEYS).decrypt_rows(rows_enc, 4, schema) == want
+    # below min_batch: the per-row path, same results
+    assert prov.decrypt_rows(rows_enc, 4, schema, min_batch=10_000) == want
