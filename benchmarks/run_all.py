@@ -82,6 +82,20 @@ def main(argv=None):
     if rc != 0:
         print(json.dumps({"warning": "kernel baseline failed validation",
                           "sentry_rc": rc}))
+
+    # static-analysis smoke (quick mode only — the full suite is already
+    # gated by tier-1's `pytest -m lint`): the Argus passes re-scan the
+    # shipped tree against tools/argus/baseline.json, so a hazard landed
+    # alongside a benchmark change is caught in the same run. Same
+    # exit-code contract as sentry: 1 = new findings, 2 = the baseline
+    # itself is malformed; either is a warning here, never a suite abort.
+    if args.quick:
+        from tools.argus import cli as argus_cli
+
+        argus_rc = argus_cli.main(["--check"])
+        if argus_rc != 0:
+            print(json.dumps({"warning": "argus static analysis not clean",
+                              "argus_rc": argus_rc}))
     return rows
 
 

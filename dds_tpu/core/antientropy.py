@@ -46,6 +46,7 @@ from dds_tpu.core import messages as M
 from dds_tpu.obs.flight import flight
 from dds_tpu.obs.metrics import metrics
 from dds_tpu.utils import sigs
+from dds_tpu.utils.tasks import supervised_task
 from dds_tpu.utils.trace import tracer
 
 log = logging.getLogger("dds.antientropy")
@@ -169,7 +170,8 @@ class AntiEntropy:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.ensure_future(self._loop())
+            self._task = supervised_task(self._loop(),
+                                         name="antientropy.loop")
 
     def cancel(self) -> None:
         """Synchronous teardown for replaced nodes (redeploy rebuilds)."""
@@ -284,7 +286,7 @@ class AntiEntropy:
                         replica=node.name,
                         help="tag-equal value-digest conflicts seen in sync",
                     )
-                    flight.record(
+                    await flight.record_async(
                         "antientropy_digest_mismatch",
                         replica=node.name, peer=peer, key=key,
                         local=[local[0].seq, local[0].id, local[1]],

@@ -37,6 +37,7 @@ from typing import Optional
 from dds_tpu.core import messages as M
 from dds_tpu.core.transport import Transport
 from dds_tpu.obs.metrics import metrics
+from dds_tpu.utils.tasks import supervised_task
 from dds_tpu.utils.trace import tracer
 
 log = logging.getLogger("dds.chaos")
@@ -285,7 +286,7 @@ class ChaosNet(Transport):
             return None
 
     def _spawn(self, coro) -> asyncio.Task:
-        task = asyncio.ensure_future(coro)
+        task = supervised_task(coro, name="chaos.delivery")
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
         return task

@@ -40,6 +40,7 @@ from dds_tpu.core.transport import Transport
 from dds_tpu.obs.flight import flight
 from dds_tpu.obs.metrics import metrics
 from dds_tpu.utils import sigs
+from dds_tpu.utils.tasks import supervised_task
 from dds_tpu.utils.trace import tracer
 
 log = logging.getLogger("dds.supervisor")
@@ -114,7 +115,8 @@ class BFTSupervisor:
 
     def start(self) -> None:
         if self.cfg.proactive_recovery_enabled and self._task is None:
-            self._task = asyncio.ensure_future(self._proactive_loop())
+            self._task = supervised_task(self._proactive_loop(),
+                                         name="supervisor.proactive")
 
     async def stop(self) -> None:
         if self._task:
@@ -155,7 +157,8 @@ class BFTSupervisor:
                     log.info("proactively recovering %s", oldest)
                 # shield: cancelling this loop (stop()) must not cancel a
                 # swap mid-flight — stop() awaits the task instead
-                rec = asyncio.ensure_future(self.recover(oldest))
+                rec = supervised_task(self.recover(oldest),
+                                      name=f"supervisor.recover:{oldest}")
                 self._inflight = rec
                 try:
                     await asyncio.shield(rec)
@@ -194,7 +197,7 @@ class BFTSupervisor:
                         replica=replica.rsplit("/", 1)[-1],
                         help="suspicion quorums reached (recovery triggers)",
                     )
-                    flight.record(
+                    await flight.record_async(
                         "suspicion_quorum", replica=replica,
                         voters=sorted(voters),
                     )

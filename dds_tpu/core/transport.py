@@ -26,6 +26,7 @@ from typing import Awaitable, Callable, Optional
 
 from dds_tpu.core import messages as M
 from dds_tpu.obs import context as obs_context
+from dds_tpu.utils.tasks import supervised_task
 
 log = logging.getLogger("dds.transport")
 
@@ -65,7 +66,8 @@ class InMemoryNet(Transport):
         return addr in self._handlers
 
     def send(self, src: str, dest: str, msg: object) -> None:
-        task = asyncio.ensure_future(self._deliver(src, dest, msg))
+        task = supervised_task(self._deliver(src, dest, msg),
+                               name=f"inmem.deliver:{dest}")
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
@@ -315,11 +317,13 @@ class TcpNet(Transport):
                     # a dropped message.
                     tc = obs_context.from_wire(obj.get("tc"))
                     if tc is not None:
-                        asyncio.ensure_future(
-                            self._handle_traced(handler, tc, src, msg)
+                        supervised_task(
+                            self._handle_traced(handler, tc, src, msg),
+                            name=f"tcp.handle:{src}",
                         )
                     else:
-                        asyncio.ensure_future(handler(src, msg))
+                        supervised_task(handler(src, msg),
+                                        name=f"tcp.handle:{src}")
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
@@ -334,7 +338,8 @@ class TcpNet(Transport):
             obs_context.detach(token)
 
     def send(self, src: str, dest: str, msg: object) -> None:
-        asyncio.ensure_future(self._send(src, dest, msg))
+        supervised_task(self._send(src, dest, msg),
+                        name=f"tcp.send:{dest}")
 
     async def _send(self, src: str, dest: str, msg: object) -> None:
         import json

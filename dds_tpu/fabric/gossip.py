@@ -28,6 +28,7 @@ import logging
 
 from dds_tpu.http.miniserver import http_request_full
 from dds_tpu.obs.metrics import metrics
+from dds_tpu.utils.tasks import supervised_task
 from dds_tpu.shard.shardmap import ShardMap
 
 log = logging.getLogger("dds.fabric.gossip")
@@ -205,7 +206,8 @@ class MapFollower:
 
     def start(self) -> None:
         if self.peers and (self._task is None or self._task.done()):
-            self._task = asyncio.ensure_future(self._loop())
+            self._task = supervised_task(self._loop(),
+                                         name="gossip.map_follower")
 
     async def stop(self) -> None:
         if self._task is not None:

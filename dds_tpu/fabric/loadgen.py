@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from dds_tpu.clt.distribution import ZipfKeys, random_row
 from dds_tpu.http.miniserver import http_request
 from dds_tpu.obs.slo import SloEngine
+from dds_tpu.utils.tasks import supervised_task
 
 log = logging.getLogger("dds.fabric.loadgen")
 
@@ -246,8 +247,9 @@ class OpenLoopLoad:
                 self.slo.observe(route, 503, self.timeout)
                 continue
             outstanding += 1
-            tasks.append(asyncio.ensure_future(
-                one(route, method, path, body, sched)
+            tasks.append(supervised_task(
+                one(route, method, path, body, sched),
+                name=f"loadgen.{route}",
             ))
         if tasks:
             await asyncio.wait(tasks, timeout=self.timeout + 1.0)

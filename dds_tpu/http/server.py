@@ -50,6 +50,7 @@ from dds_tpu.core.errors import (
 )
 from dds_tpu.core.quorum_client import AbdClient
 from dds_tpu.http import json_protocol as J
+from dds_tpu.utils.tasks import supervised_task
 from dds_tpu.http.miniserver import HttpServer, Request, Response, http_request
 from dds_tpu.models.backend import CryptoBackend, get_backend
 from dds_tpu.obs import context as obs_context
@@ -370,13 +371,16 @@ class DDSRestServer:
         self.cfg.port = self._http.port  # resolve OS-assigned port 0
         if self.cfg.key_sync_enabled and self.cfg.peers:
             await self._bootstrap_keys_from_peers()
-            self._tasks.append(asyncio.ensure_future(self._key_sync_loop()))
+            self._tasks.append(supervised_task(self._key_sync_loop(),
+                                               name="proxy.key_sync"))
         if self.cfg.supervisor:
             if self.abd.cfg.supervisor is None:
                 self.abd.cfg.supervisor = self.cfg.supervisor  # pin ActiveReplicas source
-            self._tasks.append(asyncio.ensure_future(self._replica_refresh_loop()))
+            self._tasks.append(supervised_task(self._replica_refresh_loop(),
+                                               name="proxy.replica_refresh"))
         if self.admission is not None:
-            self._tasks.append(asyncio.ensure_future(self._admission_loop()))
+            self._tasks.append(supervised_task(self._admission_loop(),
+                                               name="proxy.admission"))
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -466,7 +470,7 @@ class DDSRestServer:
                 # call — the loop is tearing down anyway)
                 await asyncio.to_thread(self._write_keys_snapshot)
 
-        self._keys_saver = asyncio.ensure_future(_saver())
+        self._keys_saver = supervised_task(_saver(), name="proxy.keys_saver")
 
     async def _bootstrap_keys_from_peers(self) -> None:
         """One-shot key pull at start: a restarted proxy must not wait for
@@ -650,7 +654,8 @@ class DDSRestServer:
                 await asyncio.sleep(self._resident_ingest_window)
                 await asyncio.to_thread(self._resident.ingest_pending)
 
-        self._ingest_task = asyncio.ensure_future(_drain())
+        self._ingest_task = supervised_task(_drain(),
+                                            name="proxy.resident_ingest")
 
     async def _fetch_stored(self) -> list[tuple[str, list]]:
         """Every stored (key, value), for the aggregate/search routes.
@@ -951,7 +956,7 @@ class DDSRestServer:
                 help="requests degraded to 503 (budget exhausted / no quorum)",
             )
             # the faulting request's whole span tree, frozen for post-mortem
-            flight.record(
+            await flight.record_async(
                 kind, trace_id=ctx.trace_id, route=route or "root",
                 method=req.method, error=str(e),
             )
@@ -1668,7 +1673,8 @@ class DDSRestServer:
         fut = loop.create_future()
         self._fold_pending.setdefault(modulus, []).append((operands, fut))
         if self._fold_drainer is None or self._fold_drainer.done():
-            self._fold_drainer = asyncio.ensure_future(self._drain_folds())
+            self._fold_drainer = supervised_task(self._drain_folds(),
+                                                 name="proxy.fold_drainer")
         return await fut
 
     def _coalesce_window(self) -> float:

@@ -28,6 +28,7 @@ DDS_OBS_FLIGHT_INTERVAL (seconds per kind, default 1.0).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -106,6 +107,21 @@ class FlightRecorder:
         except OSError as e:
             log.warning("flight recorder dump for %r failed: %s", kind, e)
             return None
+
+    async def record_async(self, kind: str, trace_id: str | None = None,
+                           **info):
+        """`record` for coroutine callers: same semantics, but the lock
+        acquisition and disk write happen on a worker thread so an
+        incident dump never stalls the event loop (which is busy running
+        every other replica in the process). The trace id is resolved
+        HERE, on the loop thread, so the faulting request's context is
+        captured before the thread hop."""
+        if not self.enabled:
+            return None
+        if trace_id is None:
+            cur = obs_context.current()
+            trace_id = cur.trace_id if cur is not None else None
+        return await asyncio.to_thread(self.record, kind, trace_id, **info)
 
     # ----------------------------------------------------------- internals
 

@@ -23,6 +23,7 @@ from dds_tpu.clt.generator import generate
 from dds_tpu.clt.instructions import Digest
 from dds_tpu.core import messages as M
 from dds_tpu.utils.sigs import generate_nonce as sigs_generate_nonce
+from dds_tpu.utils.tasks import supervised_task
 from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
 from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
 from dds_tpu.core.supervisor import BFTSupervisor, SupervisorConfig
@@ -455,7 +456,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
                     snap_secret, cfg.recovery.snapshot_keep,
                 )
 
-        task = asyncio.ensure_future(_snapshot_loop())
+        task = supervised_task(_snapshot_loop(), name="run.snapshot_loop")
 
         class _TaskStopper:
             async def stop(self):

@@ -277,8 +277,9 @@ class Rebalancer:
                     help="live resharding attempts aborted safely")
         tracer.event("shard.reshard_abort", source=source.gid,
                      target=target.gid, reason=reason)
-        flight.record("reshard_abort", source=source.gid, target=target.gid,
-                      reason=reason, epoch=old_map.epoch)
+        await flight.record_async("reshard_abort", source=source.gid,
+                                  target=target.gid, reason=reason,
+                                  epoch=old_map.epoch)
         log.warning("reshard %s -> %s aborted: %s", source.gid, target.gid,
                     reason)
         raise ReshardAborted(reason)

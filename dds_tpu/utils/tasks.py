@@ -1,0 +1,84 @@
+"""Supervised background tasks: no silent crashes, no GC'd handles.
+
+A bare ``asyncio.ensure_future(coro())`` has two failure modes the
+chaos suite cannot see: the event loop keeps only a weak reference to
+tasks, so a handle nobody stores can be garbage-collected mid-flight;
+and an exception in the coroutine is swallowed until the task object is
+finalized, which logs a "Task exception was never retrieved" long after
+the actual fault (or never, if the process dies first). Either way a
+replica's gossip follower or anti-entropy loop just stops — the
+``_key_sync_loop`` class of bug.
+
+``supervised_task`` is the repo-wide discipline (enforced by the Argus
+``async.bare-task-spawn`` rule): it retains a strong reference until the
+task finishes and attaches a done-callback that logs the crash and cuts
+a flight-recorder incident (kind ``task-crash``) at the moment it
+happens, with the task's name in the incident. Cancellation is a normal
+shutdown path and is not reported.
+
+The returned task is a plain ``asyncio.Task`` — callers keep storing it
+and awaiting it on stop exactly as before.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+from typing import Coroutine
+
+log = logging.getLogger("dds.tasks")
+
+# strong refs: the event loop itself only holds weak ones
+_TASKS: set[asyncio.Task] = set()
+
+
+def supervised_task(coro: Coroutine, name: str | None = None) -> asyncio.Task:
+    """Spawn `coro` with a retained handle and crash reporting; returns
+    the task for callers that also store/await it themselves."""
+    # the helper is the one sanctioned spawn point
+    task = asyncio.ensure_future(coro)  # argus: ok[async.bare-task-spawn]
+    if name:
+        task.set_name(name)
+    _TASKS.add(task)
+    task.add_done_callback(_reap)
+    return task
+
+
+def _reap(task: asyncio.Task) -> None:
+    _TASKS.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is None:
+        return
+    name = task.get_name()
+    log.error("supervised task %r crashed: %r", name, exc, exc_info=exc)
+    try:
+        from dds_tpu.obs.flight import flight  # lazy: avoid import cycles
+
+        # sync write is acceptable here: we are already on the fault
+        # path, and flight.record rate-limits per kind
+        flight.record(  # argus: ok[async.blocking-call]
+            "task-crash", task=name, error=repr(exc),
+            error_type=type(exc).__name__,
+        )
+    except Exception:  # reporting must never take down the loop
+        log.debug("flight record for task %r failed", name, exc_info=True)
+
+
+def supervised_count() -> int:
+    """Live supervised tasks (tests / shutdown diagnostics)."""
+    return len(_TASKS)
+
+
+async def drain(timeout: float = 5.0) -> None:
+    """Cancel and await every live supervised task — a shutdown/test
+    helper so no background task outlives its fabric."""
+    tasks = [t for t in _TASKS if not t.done()]
+    for t in tasks:
+        t.cancel()
+    if tasks:
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(
+                asyncio.gather(*tasks, return_exceptions=True), timeout)

@@ -1,0 +1,37 @@
+"""Must-flag corpus for the ``async`` pass: every rule fires.
+
+Never imported — linted as text by tests/test_argus.py. Each flagged
+line names its expected rule; the twin ``must_pass.py`` does the same
+work the sanctioned way.
+"""
+
+import asyncio
+import subprocess
+import threading
+import time
+
+from dds_tpu.obs.flight import flight
+
+_LOCK = threading.Lock()
+
+
+async def helper():
+    await asyncio.sleep(0)
+
+
+async def blocks_the_loop():
+    time.sleep(0.1)                        # async.blocking-call
+    subprocess.run(["true"])               # async.blocking-call
+    data = open("/tmp/argus-fixture").read()   # async.blocking-call
+    flight.record("incident", detail=data)     # async.blocking-call
+    return data
+
+
+async def drops_handles():
+    asyncio.ensure_future(helper())        # async.dropped-task + bare-task-spawn
+    helper()                               # async.unawaited-coroutine
+
+
+async def holds_lock_across_await():
+    with _LOCK:                            # async.lock-across-await
+        await asyncio.sleep(0.1)

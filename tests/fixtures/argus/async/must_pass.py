@@ -1,0 +1,40 @@
+"""Must-pass twin of the ``async`` corpus: the same work, sanctioned.
+
+Blocking work hops to a worker thread, incidents use the async recorder,
+spawns go through ``supervised_task`` (handle retained, crashes
+reported), and the lock held across ``await`` is an ``asyncio.Lock``.
+"""
+
+import asyncio
+
+from dds_tpu.obs.flight import flight
+from dds_tpu.utils.tasks import supervised_task
+
+_LOCK = asyncio.Lock()
+
+
+def read_fixture() -> str:
+    with open("/tmp/argus-fixture") as f:   # sync scope: fine
+        return f.read()
+
+
+async def helper():
+    await asyncio.sleep(0)
+
+
+async def yields_to_the_loop():
+    await asyncio.sleep(0.1)
+    data = await asyncio.to_thread(read_fixture)
+    await flight.record_async("incident", detail=data)
+    return data
+
+
+async def keeps_handles():
+    task = supervised_task(helper(), name="fixture.helper")
+    await task
+    await helper()
+
+
+async def holds_async_lock():
+    async with _LOCK:
+        await asyncio.sleep(0.1)

@@ -1,0 +1,26 @@
+"""Must-flag corpus for the ``dispatch`` pass: every rule fires.
+
+Never imported — linted as text by tests/test_argus.py.
+"""
+
+import jax
+import numpy as np
+
+
+def retrace_bomb(xs, m):
+    fn = jax.jit(lambda v: v % m)          # dispatch.jit-per-call
+    return fn(xs)
+
+
+def per_iteration_sync(chunks):
+    total = 0
+    for c in chunks:
+        total += c.sum().item()            # dispatch.host-roundtrip
+        host = np.asarray(c)               # dispatch.host-roundtrip
+        total += int(host[0])
+    return total
+
+
+def stray_wait(y):
+    y.block_until_ready()                  # dispatch.stray-sync
+    return y

@@ -1,0 +1,42 @@
+"""Must-pass twin of the ``dispatch`` corpus: every caching discipline
+the repo uses, plus the hoisted-transfer form of the hot loop."""
+
+import functools
+import threading
+
+import jax
+import numpy as np
+
+_FN_CACHE = {}
+_FN_LOCK = threading.Lock()
+
+
+def cached_fn(m):
+    with _FN_LOCK:
+        fn = _FN_CACHE.get(m)
+        if fn is None:
+            fn = jax.jit(lambda v: v % m)
+            _FN_CACHE[m] = fn
+    return fn
+
+
+@functools.lru_cache(maxsize=8)
+def cached_builder(m):
+    return jax.jit(lambda v: v % m)
+
+
+class Plan:
+    def __init__(self, m):
+        self._fn = jax.jit(lambda v: v % m)
+
+    @functools.cached_property
+    def doubler(self):
+        return jax.jit(lambda v: v * 2)
+
+
+def hoisted_transfer(chunks):
+    stacked = np.asarray(chunks)            # one transfer, outside the loop
+    total = 0
+    for row in stacked:
+        total += int(row[0])
+    return total

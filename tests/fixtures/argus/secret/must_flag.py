@@ -1,0 +1,28 @@
+"""Must-flag corpus for the ``secret`` pass: secret-derived values reach
+every sink class (context caches, lru builders, jit args, cached public
+modexp entries).
+
+Never imported — linted as text by tests/test_argus.py and kept in sync
+with the ORIGINAL_PATTERN fixture in tests/test_sanctum.py.
+"""
+
+import functools
+
+import jax
+
+from dds_tpu.models.modmath import ModCtx
+from dds_tpu.native import powmod
+
+
+@functools.lru_cache(maxsize=None)
+def cached_builder(n):
+    return n * n
+
+
+def decrypt_batch(key, backend, cs):
+    n2 = key.p * key.q                     # taint seed: .p / .q
+    ctx = ModCtx.make(n2)                  # secret-flow: ModCtx.make
+    fn = jax.jit(lambda c: c % n2, n2)     # secret-flow: jax.jit arg
+    cached_builder(key.lam)                # secret-flow: lru_cache builder
+    ms = backend.powmod_batch(cs, key.lam, n2)   # secret-flow: powmod_batch
+    return [powmod(c, key.lam, n2) for c in ms], ctx, fn

@@ -1,0 +1,15 @@
+"""Must-pass twin of the ``secret`` corpus: the same decrypt, lifetime-
+clean — builtin ``pow`` for host math and the consts-passing
+``powmod_batch_with_consts`` twin for device batches (no module-wide
+memoization keyed on secret-derived moduli)."""
+
+
+def decrypt_batch_host(key, cs):
+    n2 = key.p * key.q
+    lam = key.lam
+    return [pow(c, lam, n2) for c in cs]
+
+
+def decrypt_batch_device(key, backend, cs, consts):
+    n2 = key.p * key.q
+    return backend.powmod_batch_with_consts(cs, key.lam, n2, consts)

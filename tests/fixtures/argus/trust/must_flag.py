@@ -1,0 +1,28 @@
+"""Must-flag corpus for the ``trust`` pass: wire input mutates state in
+scopes with no verify/nonce guard at all.
+
+Never imported — linted as text by tests/test_argus.py.
+"""
+
+import json
+
+
+class NaiveReplica:
+    def __init__(self):
+        self.repository = {}
+        self.incoming = set()
+
+    async def handle(self, sender, msg):
+        req = json.loads(msg)
+        # trust.unverified-store: tainted key AND value, no guard in scope
+        self.repository[req["key"]] = req["value"]
+
+
+class NaiveProxy:
+    def __init__(self):
+        self.stored_keys = set()
+
+    async def on_gossip(self, payload):
+        keys = json.loads(payload)
+        for k in keys:
+            self.stored_keys.add(k)        # trust.unverified-store

@@ -1,0 +1,34 @@
+"""Must-pass twin of the ``trust`` corpus: the repo's actual handler
+idiom — signature verification plus a nonce burn before any state
+mutation (core/replica.py's shape)."""
+
+import json
+
+from dds_tpu.utils import sigs
+
+
+class GuardedReplica:
+    def __init__(self):
+        self.repository = {}
+        self.incoming = set()
+
+    async def handle(self, sender, msg):
+        req = json.loads(msg)
+        if not sigs.validate_proxy_signature(sender, req):
+            return
+        if req["nonce"] in self.incoming:       # replay: already burned
+            return
+        self.incoming.add(req["nonce"])
+        self.repository[req["key"]] = req["value"]
+
+
+class GuardedProxy:
+    def __init__(self):
+        self.stored_keys = set()
+
+    async def on_gossip(self, sender, payload):
+        keys = json.loads(payload)
+        if not sigs.verify_gossip_frame(sender, payload):
+            return
+        for k in keys:
+            self.stored_keys.add(k)

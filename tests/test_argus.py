@@ -1,0 +1,277 @@
+"""Argus static-analysis plane: tier-1 gate + engine/CLI contract.
+
+Three layers:
+
+- fixture corpora (tests/fixtures/argus/<pass>/): every must_flag.py
+  exits 1 with the expected rule set, every must_pass.py twin is clean
+  under ALL passes (a sanctioned idiom must never be noise);
+- the finding model: inline suppressions, baseline round-trip (add →
+  suppress → resurface when the flagged line changes), malformed
+  baseline → exit 2, unknown pass id → exit 2;
+- the repo gate: the shipped tree is clean under the default roots +
+  baseline, and specifically holds the zero-bare-``ensure_future``
+  discipline (utils.tasks.supervised_task everywhere).
+
+Plus runtime tests for the two fixes this plane forced:
+``utils.tasks.supervised_task`` (handle retention + crash reporting)
+and ``obs.flight.record_async`` (off-loop incident dumps).
+"""
+
+import asyncio
+import json
+import pathlib
+import shutil
+
+import pytest
+
+from dds_tpu.obs.flight import FlightRecorder
+from dds_tpu.utils import tasks as t
+from tools.argus import baseline as bl
+from tools.argus import cli
+from tools.argus.engine import lint_file, lint_source
+from tools.argus.passes import PASSES, build
+
+pytestmark = pytest.mark.lint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "argus"
+
+# pass id -> rules its must_flag corpus must produce
+EXPECTED_RULES = {
+    "async": {"blocking-call", "dropped-task", "bare-task-spawn",
+              "unawaited-coroutine", "lock-across-await"},
+    "dispatch": {"jit-per-call", "host-roundtrip", "stray-sync"},
+    "trust": {"unverified-store"},
+    "secret": {"secret-flow"},
+}
+
+# the secret corpus must cover every sink class
+EXPECTED_SECRET_SINKS = {"ModCtx.make", "jax.jit", "cached_builder",
+                         "powmod_batch", "powmod"}
+
+
+# ------------------------------------------------------------- fixture corpora
+
+
+@pytest.mark.parametrize("pass_id", sorted(PASSES))
+def test_must_flag_corpus_flags(pass_id):
+    path = FIXTURES / pass_id / "must_flag.py"
+    findings = lint_file(path, build([pass_id]))
+    assert findings, f"{path} produced no findings"
+    assert {f.rule for f in findings} == EXPECTED_RULES[pass_id]
+    # CLI contract: pointing the tool at a must-flag corpus exits 1
+    rc = cli.main([str(path), "--passes", pass_id, "--no-baseline"])
+    assert rc == 1
+
+
+@pytest.mark.parametrize("pass_id", sorted(PASSES))
+def test_must_pass_twin_is_clean_under_all_passes(pass_id):
+    path = FIXTURES / pass_id / "must_pass.py"
+    findings = lint_file(path, build())
+    assert findings == [], [str(f) for f in findings]
+    rc = cli.main([str(path), "--no-baseline"])
+    assert rc == 0
+
+
+def test_secret_corpus_covers_every_sink_class():
+    path = FIXTURES / "secret" / "must_flag.py"
+    findings = lint_file(path, build(["secret"]))
+    assert {f.symbol for f in findings} == EXPECTED_SECRET_SINKS
+
+
+def test_findings_carry_location_pass_and_trace():
+    path = FIXTURES / "secret" / "must_flag.py"
+    f = lint_file(path, build(["secret"]))[0]
+    d = f.to_dict()
+    assert d["line"] > 0 and d["pass"] == "secret" and d["rule"]
+    assert d["trace"], "taint findings must carry the propagation trace"
+    assert str(f).startswith(f"{f.path}:{f.line}:")
+
+
+# ------------------------------------------------------------- suppressions
+
+
+def test_inline_suppression_silences_one_rule():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # argus: ok[async.blocking-call] fixture\n"
+        "    time.sleep(2)\n"
+    )
+    findings = lint_source(src, "x.py", build(["async"]))
+    assert [f.line for f in findings] == [4]
+
+
+def test_blanket_suppression_silences_the_line():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # argus: ok\n"
+    )
+    assert lint_source(src, "x.py", build(["async"])) == []
+
+
+def test_wrong_rule_suppression_does_not_silence():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # argus: ok[dispatch.jit-per-call]\n"
+    )
+    findings = lint_source(src, "x.py", build(["async"]))
+    assert len(findings) == 1
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def test_baseline_round_trip(tmp_path):
+    """add → suppress → resurface when the flagged line itself changes."""
+    work = tmp_path / "corpus.py"
+    shutil.copy(FIXTURES / "async" / "must_flag.py", work)
+    base = tmp_path / "baseline.json"
+
+    argv = [str(work), "--passes", "async", "--baseline", str(base)]
+    assert cli.main(argv) == 1                      # add: findings exist
+    assert cli.main(argv + ["--write-baseline"]) == 0
+    assert cli.main(argv) == 0                      # suppressed by baseline
+
+    # a pure line shift must NOT resurface anything (snippet-keyed match)
+    work.write_text("# a comment pushed everything down one line\n"
+                    + work.read_text())
+    assert cli.main(argv) == 0
+
+    # but editing a flagged line itself must resurface that finding
+    work.write_text(work.read_text().replace(
+        "time.sleep(0.1)", "time.sleep(0.25)"))
+    assert cli.main(argv) == 1
+
+
+def test_malformed_baseline_exits_2(tmp_path):
+    path = FIXTURES / "async" / "must_flag.py"
+    for bad in (
+        '{"not": "a list"}',
+        '[{"path": "x"}]',                               # missing keys
+        json.dumps([{"path": "x", "pass": "async", "rule": "r",
+                     "scope": "s", "snippet": "y", "reason": "   "}]),
+        "not json at all",
+    ):
+        base = tmp_path / "baseline.json"
+        base.write_text(bad)
+        rc = cli.main([str(path), "--passes", "async",
+                       "--baseline", str(base)])
+        assert rc == 2, f"baseline {bad!r} should be rejected"
+    with pytest.raises(bl.BaselineError):
+        bl.load_baseline(base)
+
+
+def test_unknown_pass_exits_2():
+    assert cli.main(["--passes", "nonsense"]) == 2
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert bl.load_baseline(tmp_path / "absent.json") == []
+
+
+# ---------------------------------------------------------------- repo gate
+
+
+def test_repo_is_clean_under_default_roots_and_baseline():
+    findings = cli.lint_repo()
+    entries = bl.load_baseline()
+    new, unused = bl.split_findings(findings, entries)
+    assert new == [], "\n".join(str(f) for f in new)
+    assert unused == [], f"stale baseline entries: {unused}"
+
+
+def test_repo_clean_via_cli_exit_code():
+    assert cli.main(["--check"]) == 0
+
+
+def test_no_bare_ensure_future_in_dds_tpu():
+    """The satellite discipline: every spawn in dds_tpu/ goes through
+    utils.tasks.supervised_task (AST-backed, so docstrings don't count)."""
+    findings = cli.lint_repo(pass_ids=["async"])
+    spawns = [f for f in findings if f.rule == "bare-task-spawn"]
+    assert spawns == [], "\n".join(str(f) for f in spawns)
+
+
+def test_every_baseline_entry_has_a_real_reason():
+    for entry in bl.load_baseline():
+        assert len(entry["reason"].strip()) > 20, entry
+
+
+# ------------------------------------------------- runtime: the forced fixes
+
+
+def test_supervised_task_retains_handle_and_reports_crash(caplog):
+    async def scenario():
+        async def ok():
+            return 41
+
+        async def boom():
+            raise RuntimeError("fixture crash")
+
+        good = t.supervised_task(ok(), name="argus.ok")
+        bad = t.supervised_task(boom(), name="argus.boom")
+        assert t.supervised_count() >= 2
+        assert await good == 41
+        with pytest.raises(RuntimeError):
+            await bad
+        await asyncio.sleep(0)              # let done-callbacks run
+        assert good not in t._TASKS and bad not in t._TASKS
+
+    with caplog.at_level("ERROR", logger="dds.tasks"):
+        asyncio.run(scenario())
+    crash_logs = [r for r in caplog.records if "argus.boom" in r.getMessage()]
+    assert crash_logs, "task crash must be logged with the task name"
+
+
+def test_supervised_task_cancellation_is_silent(caplog):
+    async def scenario():
+        async def forever():
+            await asyncio.sleep(3600)
+
+        task = t.supervised_task(forever(), name="argus.cancelled")
+        await asyncio.sleep(0)
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        await asyncio.sleep(0)
+
+    with caplog.at_level("ERROR", logger="dds.tasks"):
+        asyncio.run(scenario())
+    assert not [r for r in caplog.records
+                if "argus.cancelled" in r.getMessage()]
+
+
+def test_drain_cancels_leftover_tasks():
+    async def scenario():
+        async def forever():
+            await asyncio.sleep(3600)
+
+        t.supervised_task(forever(), name="argus.leftover")
+        await t.drain(timeout=1.0)
+        assert t.supervised_count() == 0
+
+    asyncio.run(scenario())
+
+
+def test_flight_record_async_matches_sync_record(tmp_path):
+    fr = FlightRecorder(dir=str(tmp_path), min_interval=0.0)
+
+    async def scenario():
+        return await fr.record_async("argus_incident", detail="x")
+
+    path = asyncio.run(scenario())
+    assert path is not None
+    header = json.loads(pathlib.Path(path).read_text().splitlines()[0])
+    assert header["incident"] == "argus_incident"
+    assert header["info"] == {"detail": "x"}
+
+
+def test_flight_record_async_disabled_is_none():
+    fr = FlightRecorder(dir=None)
+
+    async def scenario():
+        return await fr.record_async("nope")
+
+    assert asyncio.run(scenario()) is None

@@ -1,0 +1,33 @@
+"""Argus: the repo-wide static-analysis plane.
+
+One shared scope/taint/AST engine (``tools/argus/engine.py``) runs four
+passes over the tree:
+
+- ``async``  — async-hazard: blocking calls inside coroutines, un-awaited
+  coroutine calls, dropped/unsupervised task handles, threading locks
+  held across ``await`` (``passes/async_hazard.py``);
+- ``dispatch`` — dispatch-hygiene: per-call ``jax.jit`` construction
+  outside the ``_FN_CACHE``/``lru_cache``/``cached_property`` discipline,
+  device→host round-trips inside hot-path loops, stray
+  ``block_until_ready`` outside ``obs/kprof.profiled``'s dispatch/execute
+  split (``passes/dispatch.py``);
+- ``trust`` — trust-boundary: wire-deserialized input flowing into
+  store/state mutation in a scope with no HMAC-verify/nonce-burn guard
+  (``passes/trust_boundary.py``);
+- ``secret`` — the Sanctum secret-material taint profile that
+  ``tools/secret_lint.py`` pioneered, now a pass of the shared engine
+  (``passes/secret_taint.py``).
+
+Findings carry ``file:line``, the pass id, a rule id, and (for taint
+passes) the propagation trace. Intentional exceptions are either inline
+(``# argus: ok[pass.rule] reason``) or entries in
+``tools/argus/baseline.json`` — every entry MUST carry a reason string;
+a malformed baseline is exit code 2 (the ``obs/sentry.py`` contract),
+new findings are exit code 1, clean is 0.
+
+Tier-1 entry points: ``pytest -m lint`` (tests/test_argus.py) and the
+standalone CLI ``python -m tools.argus [--check] [--json]``.
+"""
+
+from tools.argus.engine import Finding, lint_file, lint_source  # noqa: F401
+from tools.argus.cli import lint_repo, main  # noqa: F401

@@ -1,0 +1,99 @@
+"""Argus baseline: reviewed, justified exceptions that persist on disk.
+
+``tools/argus/baseline.json`` is a JSON list of entries; each matches
+findings by the same key the engine uses —
+
+    (path, pass, rule, scope, snippet)
+
+— where ``snippet`` is the stripped source line. Matching on content
+rather than line number means pure line shifts (an import added above)
+do not resurface a baselined finding, but ANY edit to the flagged line
+itself does, forcing a re-review. Every entry MUST carry a non-empty
+``reason`` string; an entry without one — or any other shape problem —
+is a *malformed baseline* and the CLI exits 2 (the ``obs/sentry.py``
+contract), so a broken exception file can never silently pass the gate.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from tools.argus.engine import Finding
+
+DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+REQUIRED_KEYS = ("path", "pass", "rule", "scope", "snippet", "reason")
+
+
+class BaselineError(ValueError):
+    """The baseline file is malformed (CLI exit code 2)."""
+
+
+def entry_key(entry: dict) -> tuple:
+    return (entry["path"], entry["pass"], entry["rule"], entry["scope"],
+            entry["snippet"])
+
+
+def load_baseline(path: str | pathlib.Path | None = None) -> list[dict]:
+    """Parse and validate the baseline. A missing file is an empty
+    baseline; anything present must be fully well-formed."""
+    p = pathlib.Path(path) if path is not None else DEFAULT_BASELINE
+    if not p.exists():
+        return []
+    try:
+        data = json.loads(p.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise BaselineError(f"{p}: unreadable baseline: {e}") from e
+    if not isinstance(data, list):
+        raise BaselineError(f"{p}: baseline must be a JSON list of entries")
+    for i, entry in enumerate(data):
+        if not isinstance(entry, dict):
+            raise BaselineError(f"{p}: entry {i} is not an object")
+        missing = [k for k in REQUIRED_KEYS if k not in entry]
+        if missing:
+            raise BaselineError(
+                f"{p}: entry {i} missing key(s): {', '.join(missing)}")
+        for k in REQUIRED_KEYS:
+            if not isinstance(entry[k], str):
+                raise BaselineError(f"{p}: entry {i} field {k!r} must be a "
+                                    f"string")
+        if not entry["reason"].strip():
+            raise BaselineError(
+                f"{p}: entry {i} ({entry['path']} {entry['pass']}."
+                f"{entry['rule']}) has an empty reason — every baselined "
+                f"finding must say why it is acceptable")
+    return data
+
+
+def split_findings(findings: list[Finding],
+                   entries: list[dict]) -> tuple[list[Finding], list[dict]]:
+    """(new_findings, unused_entries): findings with no baseline entry,
+    and entries that matched nothing (stale — the code was fixed or the
+    line changed, so the exception should be deleted or re-reviewed)."""
+    keys = {entry_key(e) for e in entries}
+    new = [f for f in findings if f.key not in keys]
+    found = {f.key for f in findings}
+    unused = [e for e in entries if entry_key(e) not in found]
+    return new, unused
+
+
+def as_entry(finding: Finding, reason: str) -> dict:
+    return {
+        "path": finding.path, "pass": finding.pass_id, "rule": finding.rule,
+        "scope": finding.scope, "snippet": finding.snippet,
+        "reason": reason,
+    }
+
+
+def write_baseline(findings: list[Finding],
+                   path: str | pathlib.Path | None = None,
+                   reason: str = "unreviewed: recorded by --write-baseline "
+                                 "(replace with a real justification)") -> int:
+    """Record every finding as a baseline entry. Returns the entry count.
+    The placeholder reason keeps the file well-formed but is meant to be
+    edited before review."""
+    p = pathlib.Path(path) if path is not None else DEFAULT_BASELINE
+    entries = [as_entry(f, reason) for f in findings]
+    p.write_text(json.dumps(entries, indent=2, sort_keys=True) + "\n")
+    return len(entries)

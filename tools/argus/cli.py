@@ -1,0 +1,139 @@
+"""Argus CLI: repo walking, baseline application, CI-grade exit codes.
+
+    python -m tools.argus [paths...] [--passes async,dispatch]
+                          [--baseline FILE | --no-baseline]
+                          [--write-baseline] [--json] [--check]
+
+Exit codes (the ``obs/sentry.py`` contract, shared with secret_lint):
+
+- 0 — every scanned file clean (or every finding baselined/suppressed);
+- 1 — new findings;
+- 2 — malformed baseline or unknown pass id (configuration error beats
+  analysis results: a gate that cannot read its exception list must not
+  report "clean").
+
+Default scan roots cover the shipped tree (``dds_tpu``, ``tools``,
+``benchmarks``, the top-level entry scripts) but NOT ``tests/`` — the
+must-flag fixture corpora live there and are linted explicitly by
+tests/test_argus.py, each corpus asserted to flag.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from tools.argus import baseline as bl
+from tools.argus.engine import REPO_ROOT, Finding, lint_file
+from tools.argus.passes import PASSES, build
+
+DEFAULT_ROOTS = ("dds_tpu", "tools", "benchmarks", "bench.py", "run.py")
+SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def iter_py_files(targets, repo_root: pathlib.Path = REPO_ROOT):
+    for target in targets:
+        p = pathlib.Path(target)
+        if not p.is_absolute():
+            p = repo_root / p
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in SKIP_DIRS for part in f.parts):
+                    yield f
+
+
+def lint_paths(paths, passes) -> list[Finding]:
+    out: list[Finding] = []
+    for p in paths:
+        out.extend(lint_file(p, passes))
+    return out
+
+
+def lint_repo(repo_root: str | pathlib.Path | None = None,
+              pass_ids=None) -> list[Finding]:
+    """All findings over the default roots (inline suppressions applied,
+    baseline NOT applied — callers decide how exceptions are handled)."""
+    root = pathlib.Path(repo_root) if repo_root else REPO_ROOT
+    passes = build(pass_ids)
+    return lint_paths(iter_py_files(DEFAULT_ROOTS, root), passes)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.argus",
+        description="repo-wide static analysis: async-hazard, "
+                    "dispatch-hygiene, trust-boundary, secret-taint",
+    )
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_ROOTS),
+                    help="files/dirs to scan (default: shipped tree)")
+    ap.add_argument("--passes", default=None, metavar="IDS",
+                    help=f"comma-separated pass ids (default: all of "
+                         f"{','.join(PASSES)})")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline file (default: tools/argus/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record current findings as the baseline and exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (one JSON object)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: counts only on stdout, same exit codes")
+    args = ap.parse_args(argv)
+
+    pass_ids = None
+    if args.passes:
+        pass_ids = [p.strip() for p in args.passes.split(",") if p.strip()]
+    try:
+        passes = build(pass_ids)
+    except KeyError as e:
+        print(f"argus: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(iter_py_files(args.paths), passes)
+
+    entries: list[dict] = []
+    if not args.no_baseline:
+        try:
+            entries = bl.load_baseline(args.baseline)
+        except bl.BaselineError as e:
+            print(f"argus: malformed baseline: {e}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        n = bl.write_baseline(findings, args.baseline)
+        target = args.baseline or bl.DEFAULT_BASELINE
+        print(f"argus: wrote {n} entr{'y' if n == 1 else 'ies'} to {target}")
+        return 0
+
+    new, unused = bl.split_findings(findings, entries)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "baselined": len(findings) - len(new),
+            "stale_baseline_entries": [bl.entry_key(e) for e in unused],
+            "passes": [p.pass_id for p in passes],
+        }, indent=2))
+    elif args.check:
+        print(f"argus: {len(new)} new finding(s), "
+              f"{len(findings) - len(new)} baselined, "
+              f"{len(unused)} stale baseline entr"
+              f"{'y' if len(unused) == 1 else 'ies'}")
+    else:
+        for f in new:
+            print(f)
+        if findings and not new:
+            print(f"argus: clean ({len(findings) - len(new)} baselined)")
+        elif not findings:
+            print("argus: clean")
+        for e in unused:
+            print(f"argus: stale baseline entry (code no longer flags): "
+                  f"{e['path']} [{e['pass']}.{e['rule']}] {e['snippet']!r}",
+                  file=sys.stderr)
+
+    return 1 if new else 0

@@ -1,0 +1,380 @@
+"""Argus engine: scopes, the fixpoint taint pass, findings, suppression.
+
+This is the machinery ``tools/secret_lint.py`` proved out (per-scope
+fixpoint taint over assignment/loop/walrus bindings), generalized so a
+pass is just data: a *seed* predicate (which expressions introduce
+taint), a *sink* resolver (which calls must never receive it), and an
+optional *guard* predicate (scope-level sanitizers, e.g. an HMAC verify).
+Non-taint rules (blocking calls in coroutines, per-call jit) use the
+same scope walker and finding model.
+
+Deliberately intra-procedural and conservative in ONE direction per
+pass: a pass can miss cross-function flows (each pass's sink list closes
+the known ones), but a clean report means no syntactic instance of the
+bug class exists in the scanned tree — the property tier-1 freezes.
+
+Suppression is inline and per-rule: a ``# argus: ok[pass.rule] reason``
+comment on the flagged line silences exactly that rule there (``# argus:
+ok`` silences every pass on the line); everything else goes through the
+reviewed baseline file (tools/argus/baseline.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+# ------------------------------------------------------------------ findings
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. `snippet` (the stripped source line) rather
+    than the line number keys baseline matching: pure line shifts from
+    edits elsewhere do not resurface a baselined finding, but any change
+    to the flagged line itself does."""
+
+    path: str                       # repo-relative when under the repo
+    line: int
+    pass_id: str                    # "async" | "dispatch" | "trust" | "secret"
+    rule: str                       # e.g. "blocking-call"
+    message: str
+    symbol: str = ""                # the call/sink the finding is about
+    scope: str = ""                 # enclosing def (dotted) or "<module>"
+    snippet: str = ""
+    trace: tuple[str, ...] = ()     # taint propagation steps, seed first
+
+    @property
+    def key(self) -> tuple:
+        return (self.path, self.pass_id, self.rule, self.scope, self.snippet)
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path, "line": self.line, "pass": self.pass_id,
+            "rule": self.rule, "symbol": self.symbol, "scope": self.scope,
+            "message": self.message, "snippet": self.snippet,
+            "trace": list(self.trace),
+        }
+
+    def __str__(self) -> str:
+        s = (f"{self.path}:{self.line}: [{self.pass_id}.{self.rule}] "
+             f"{self.message}")
+        if self.trace:
+            s += "\n    taint: " + " -> ".join(self.trace)
+        return s
+
+
+# -------------------------------------------------------------------- scopes
+
+
+@dataclass
+class Scope:
+    """One analysis scope: the module body or one (async) function body.
+    Nested defs get their own Scope; statements of nested defs are NOT
+    part of the enclosing scope's walk."""
+
+    node: ast.AST                   # Module | FunctionDef | AsyncFunctionDef
+    name: str                       # dotted: "Cls.meth" / "<module>"
+    is_async: bool
+    body: list[ast.stmt] = field(default_factory=list)
+    parent: "Scope | None" = None
+    decorators: tuple[str, ...] = ()
+
+    @property
+    def args(self) -> list[str]:
+        a = getattr(self.node, "args", None)
+        if a is None:
+            return []
+        names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+def _decorator_names(node: ast.AST) -> tuple[str, ...]:
+    out = []
+    for dec in getattr(node, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Attribute):
+            out.append(target.attr)
+        elif isinstance(target, ast.Name):
+            out.append(target.id)
+    return tuple(out)
+
+
+def iter_scopes(tree: ast.Module):
+    """Every analysis scope in the module: the module body first, then
+    each function/method (async or not), depth-first, with dotted names
+    through enclosing classes/functions."""
+    mod = Scope(tree, "<module>", False, tree.body)
+    yield mod
+
+    def walk(body, prefix, parent):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{prefix}{stmt.name}"
+                sc = Scope(
+                    stmt, name, isinstance(stmt, ast.AsyncFunctionDef),
+                    stmt.body, parent, _decorator_names(stmt),
+                )
+                yield sc
+                yield from walk(stmt.body, name + ".", sc)
+            elif isinstance(stmt, ast.ClassDef):
+                yield from walk(stmt.body, f"{prefix}{stmt.name}.", parent)
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.stmt):
+                        yield from walk([child], prefix, parent)
+
+    yield from walk(tree.body, "", mod)
+
+
+def walked_stmts(body: list[ast.stmt]):
+    """All statements in `body`, descending into compound statements but
+    never into nested function/class definitions (those are separate
+    scopes)."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                yield from walked_stmts([child])
+
+
+def scope_calls(body: list[ast.stmt]):
+    """Every Call expression reachable from `body` without entering a
+    nested def (lambdas and comprehensions ARE entered — they execute in
+    this scope)."""
+    for stmt in walked_stmts(body):
+        skip: set[int] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node is not stmt:
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and id(node) not in skip:
+                yield node
+
+
+def dotted_name(node: ast.expr) -> str:
+    """Best-effort dotted name of a call target / attribute chain:
+    `a.b.c` -> "a.b.c"; anything non-name-ish becomes "?"."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{dotted_name(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return f"{dotted_name(node.func)}()"
+    return "?"
+
+
+def names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# --------------------------------------------------------------- taint pass
+
+
+def assign_pairs(stmt: ast.stmt):
+    """(target, value) pairs for binding statements, tuple-to-tuple split
+    elementwise; match-case subjects pair with every captured name."""
+    pairs = []
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            pairs.append((tgt, stmt.value))
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        pairs.append((stmt.target, stmt.value))
+    elif isinstance(stmt, ast.AugAssign):
+        pairs.append((stmt.target, stmt.value))
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        pairs.append((stmt.target, stmt.iter))
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                pairs.append((item.optional_vars, item.context_expr))
+    out = []
+    for tgt, val in pairs:
+        if (isinstance(tgt, (ast.Tuple, ast.List))
+                and isinstance(val, (ast.Tuple, ast.List))
+                and len(tgt.elts) == len(val.elts)):
+            out.extend(zip(tgt.elts, val.elts))
+        else:
+            out.append((tgt, val))
+    return out
+
+
+def _match_captures(case: ast.match_case) -> set[str]:
+    """Names bound by a match-case pattern (MatchAs/MatchStar/
+    MatchMapping rest captures) — `case M.Read(key, nonce):` binds both."""
+    names: set[str] = set()
+    for node in ast.walk(case.pattern):
+        if isinstance(node, (ast.MatchAs, ast.MatchStar)) and node.name:
+            names.add(node.name)
+        if isinstance(node, ast.MatchMapping) and node.rest:
+            names.add(node.rest)
+    return names
+
+
+class Taint:
+    """Per-scope fixpoint taint state: name -> propagation trace (seed
+    description first, one step per binding hop)."""
+
+    def __init__(self, seed_fn):
+        # seed_fn(expr) -> str | None: a human-readable label when this
+        # expression INTRODUCES taint (e.g. "read of .p")
+        self.seed_fn = seed_fn
+        self.traces: dict[str, tuple[str, ...]] = {}
+
+    def expr_trace(self, node: ast.AST) -> tuple[str, ...] | None:
+        """The taint trace of an expression, or None when untainted.
+        Direct seeds win (shortest trace); tainted names propagate."""
+        for sub in ast.walk(node):
+            label = self.seed_fn(sub)
+            if label:
+                return (f"{label} (line {getattr(sub, 'lineno', '?')})",)
+        for name in names_in(node):
+            if name in self.traces:
+                return self.traces[name]
+        return None
+
+    def run(self, body: list[ast.stmt]) -> "Taint":
+        changed = True
+        while changed:
+            changed = False
+            for stmt in walked_stmts(body):
+                for tgt, val in assign_pairs(stmt):
+                    tr = self.expr_trace(val)
+                    if tr is None:
+                        continue
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name) and n.id not in self.traces:
+                            self.traces[n.id] = tr + (
+                                f"{n.id} (line {stmt.lineno})",
+                            )
+                            changed = True
+                if isinstance(stmt, ast.Match):
+                    tr = self.expr_trace(stmt.subject)
+                    if tr is not None:
+                        for case in stmt.cases:
+                            for name in _match_captures(case):
+                                if name not in self.traces:
+                                    self.traces[name] = tr + (
+                                        f"{name} (case line {case.pattern.lineno})",
+                                    )
+                                    changed = True
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.NamedExpr):
+                        tr = self.expr_trace(node.value)
+                        if tr is not None and isinstance(node.target, ast.Name) \
+                                and node.target.id not in self.traces:
+                            self.traces[node.target.id] = tr + (
+                                f"{node.target.id} (line {node.lineno})",
+                            )
+                            changed = True
+        return self
+
+    def seed_param(self, name: str, why: str) -> None:
+        self.traces[name] = (f"{why} parameter {name!r}",)
+
+
+def taint_scope(scope: Scope, seed_fn) -> Taint:
+    return Taint(seed_fn).run(scope.body)
+
+
+# --------------------------------------------------------------- suppression
+
+_OK_RE = re.compile(r"#\s*argus:\s*ok(?:\[([a-z0-9_.,\- ]+)\])?")
+
+
+def suppressions(src: str) -> dict[int, set[str] | None]:
+    """line number -> suppressed rule set ("pass.rule" ids), or None for
+    a blanket `# argus: ok`."""
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _OK_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def apply_suppressions(findings: list[Finding], src: str) -> list[Finding]:
+    supp = suppressions(src)
+    if not supp:
+        return findings
+    kept = []
+    for f in findings:
+        rules = supp.get(f.line, ...)
+        if rules is ...:
+            kept.append(f)
+        elif rules is not None and f"{f.pass_id}.{f.rule}" not in rules:
+            kept.append(f)
+    return kept
+
+
+# ------------------------------------------------------------------- linting
+
+
+def rel_path(path: str | pathlib.Path) -> str:
+    p = pathlib.Path(path)
+    try:
+        return str(p.resolve().relative_to(REPO_ROOT))
+    except ValueError:
+        return str(p)
+
+
+def _snippet(src_lines: list[str], line: int) -> str:
+    if 1 <= line <= len(src_lines):
+        return src_lines[line - 1].strip()
+    return ""
+
+
+def lint_source(src: str, path: str, passes) -> list[Finding]:
+    """Run `passes` (objects with .run(tree, scope iterator is theirs to
+    build, path)) over one source text. Syntax errors become a finding of
+    the synthetic `parse` pass so a broken file fails the gate loudly."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(rel_path(path), e.lineno or 0, "parse",
+                        "syntax-error", str(e))]
+    src_lines = src.splitlines()
+    out: list[Finding] = []
+    rp = rel_path(path)
+    for p in passes:
+        if not p.applies(rp):
+            continue
+        for f in p.run(tree, src, rp):
+            if not f.snippet:
+                f = Finding(f.path, f.line, f.pass_id, f.rule, f.message,
+                            f.symbol, f.scope, _snippet(src_lines, f.line),
+                            f.trace)
+            out.append(f)
+    out = apply_suppressions(out, src)
+    # dedupe: one (path, line, rule, symbol) regardless of walk overlap
+    seen: set[tuple] = set()
+    uniq = []
+    for f in sorted(out, key=lambda f: (f.path, f.line, f.pass_id, f.rule)):
+        k = (f.path, f.line, f.pass_id, f.rule, f.symbol)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(f)
+    return uniq
+
+
+def lint_file(path: str | pathlib.Path, passes) -> list[Finding]:
+    p = pathlib.Path(path)
+    return lint_source(p.read_text(), str(p), passes)

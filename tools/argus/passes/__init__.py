@@ -1,0 +1,33 @@
+"""Argus pass registry: id -> pass factory.
+
+A pass instance exposes:
+- ``pass_id``  — the id findings/suppressions/baselines use;
+- ``applies(rel_path) -> bool`` — which files it scans;
+- ``run(tree, src, rel_path) -> list[Finding]``.
+
+Adding a pass: implement the three members in a new module here,
+register it in PASSES, document it in DEPLOY.md's pass catalog, and give
+it a must-flag/must-pass fixture twin under tests/fixtures/argus/.
+"""
+
+from tools.argus.passes.async_hazard import AsyncHazardPass
+from tools.argus.passes.dispatch import DispatchHygienePass
+from tools.argus.passes.secret_taint import SecretTaintPass
+from tools.argus.passes.trust_boundary import TrustBoundaryPass
+
+PASSES = {
+    "async": AsyncHazardPass,
+    "dispatch": DispatchHygienePass,
+    "trust": TrustBoundaryPass,
+    "secret": SecretTaintPass,
+}
+
+
+def build(ids=None) -> list:
+    """Instantiate the selected passes (default: all, stable order)."""
+    if ids is None:
+        ids = list(PASSES)
+    unknown = [i for i in ids if i not in PASSES]
+    if unknown:
+        raise KeyError(f"unknown pass id(s): {', '.join(unknown)}")
+    return [PASSES[i]() for i in ids]

@@ -1,0 +1,160 @@
+"""Argus pass ``dispatch``: jit/dispatch hygiene on the device hot path.
+
+BENCH_r03 showed the fold path is dispatch-bound (87 ms single dispatch
+vs 28 ms pipelined): the structural bugs that recreate that wall are a
+``jax.jit`` object constructed per call (every call retraces — the
+retrace bomb), a device→host round-trip inside a hot loop (each one
+serializes the pipeline), and a stray ``block_until_ready`` outside
+``obs/kprof.profiled``'s dispatch/execute split (which both stalls and
+corrupts the phase accounting the perf sentry gates on). HEAAN-
+demystified's thesis applies: these are detectable in the source, not
+just in a profile. Rules:
+
+- ``jit-per-call`` — ``jax.jit(...)`` inside a function scope with none
+  of the repo's caching disciplines: an ``lru_cache``/``cache``/
+  ``cached_property`` decorator on the builder, insertion into a
+  ``*_FN_CACHE`` dict (directly or via a ``*fn_cache*`` helper), or
+  assignment onto ``self`` (a per-instance compiled-fn cache, the
+  Sanctum plan pattern). Module-level jit is always fine.
+- ``host-roundtrip`` — ``.item()`` / ``np.asarray`` / ``np.array`` on
+  the hot-path modules (ops/, resident/, parallel/, sanctum/) inside a
+  ``for``/``while`` body: per-iteration host syncs serialize the device
+  pipeline; hoist the transfer out of the loop or keep the value
+  device-resident.
+- ``stray-sync`` — ``block_until_ready`` anywhere in ``dds_tpu/``
+  outside ``obs/kprof.py``: device waits belong in ``kprof.profiled``
+  so dispatch and execute stay separately accounted.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.argus.engine import Finding, dotted_name, iter_scopes, scope_calls
+
+HOT_PATH_PARTS = ("dds_tpu/ops/", "dds_tpu/resident/", "dds_tpu/parallel/",
+                  "dds_tpu/sanctum/")
+SYNC_EXEMPT = ("dds_tpu/obs/kprof.py",)
+CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
+HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+class DispatchHygienePass:
+    pass_id = "dispatch"
+
+    def applies(self, rel_path: str) -> bool:
+        # fixture corpora are honorary hot-path files so the CLI flags
+        # them when pointed at tests/fixtures/argus/ directly
+        return (rel_path.startswith("dds_tpu/") or "/dds_tpu/" in rel_path
+                or "fixtures/argus" in rel_path)
+
+    def run(self, tree: ast.Module, src: str, rel_path: str) -> list[Finding]:
+        out: list[Finding] = []
+        for scope in iter_scopes(tree):
+            if scope.name != "<module>":
+                out += self._jit_per_call(scope, rel_path)
+        hot = ("fixtures/argus" in rel_path
+               or any(p in rel_path for p in HOT_PATH_PARTS))
+        if hot:
+            out += self._host_roundtrips(tree, rel_path)
+        if not any(e in rel_path for e in SYNC_EXEMPT):
+            out += self._stray_sync(tree, rel_path)
+        return out
+
+    # ------------------------------------------------------------ jit rule
+
+    @staticmethod
+    def _disciplined(scope) -> bool:
+        """True when this function scope (or an enclosing one) follows a
+        compiled-fn caching discipline."""
+        sc = scope
+        while sc is not None:
+            if set(sc.decorators) & CACHE_DECORATORS:
+                return True
+            sc = sc.parent
+        for node in ast.walk(scope.node):
+            # fn cached into a module dict: _FN_CACHE[key] = fn, or via a
+            # helper (_fn_cache_put(key, fn))
+            if isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, ast.Store):
+                base = dotted_name(node.value).rsplit(".", 1)[-1]
+                if "fn_cache" in base.lower():
+                    return True
+            if isinstance(node, ast.Call):
+                if "fn_cache" in dotted_name(node.func).lower():
+                    return True
+            # per-instance cache: self._fn = jax.jit(...)
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and isinstance(
+                            tgt.value, ast.Name) and tgt.value.id == "self":
+                        return True
+        return False
+
+    def _jit_per_call(self, scope, rel_path: str) -> list[Finding]:
+        jit_calls = [
+            c for c in scope_calls(scope.body)
+            if dotted_name(c.func) in ("jax.jit", "jit")
+        ]
+        if not jit_calls or self._disciplined(scope):
+            return []
+        return [
+            Finding(
+                rel_path, c.lineno, self.pass_id, "jit-per-call",
+                f"jax.jit constructed per call in {scope.name} — every "
+                f"invocation retraces and recompiles; cache the jitted fn "
+                f"(_FN_CACHE / functools.lru_cache / cached_property / an "
+                f"instance attribute)",
+                symbol="jax.jit", scope=scope.name,
+            )
+            for c in jit_calls
+        ]
+
+    # ------------------------------------------------------- host roundtrip
+
+    def _host_roundtrips(self, tree: ast.Module, rel_path: str) -> list[Finding]:
+        out = []
+        for scope in iter_scopes(tree):
+            loops = [
+                n for stmt in scope.body for n in ast.walk(stmt)
+                if isinstance(n, (ast.For, ast.While))
+            ]
+            for loop in loops:
+                for node in ast.walk(loop):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted_name(node.func)
+                    sync = None
+                    if isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "item" and not node.args:
+                        sync = ".item()"
+                    elif name in HOST_SYNC_CALLS:
+                        sync = name
+                    if sync:
+                        out.append(Finding(
+                            rel_path, node.lineno, self.pass_id,
+                            "host-roundtrip",
+                            f"device→host round-trip {sync} inside a loop "
+                            f"in {scope.name} — per-iteration host syncs "
+                            f"serialize the pipeline; hoist the transfer "
+                            f"or keep it device-resident",
+                            symbol=sync, scope=scope.name,
+                        ))
+        return out
+
+    # ----------------------------------------------------------- stray sync
+
+    def _stray_sync(self, tree: ast.Module, rel_path: str) -> list[Finding]:
+        out = []
+        for scope in iter_scopes(tree):
+            for call in scope_calls(scope.body):
+                name = dotted_name(call.func)
+                if name.rsplit(".", 1)[-1] == "block_until_ready":
+                    out.append(Finding(
+                        rel_path, call.lineno, self.pass_id, "stray-sync",
+                        f"block_until_ready outside obs/kprof.profiled in "
+                        f"{scope.name} — device waits belong in the "
+                        f"dispatch/execute split the perf sentry gates on",
+                        symbol="block_until_ready", scope=scope.name,
+                    ))
+        return out

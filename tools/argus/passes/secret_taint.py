@@ -1,0 +1,143 @@
+"""Argus pass ``secret``: the Sanctum secret-material taint profile.
+
+The original ``tools/secret_lint.py`` analysis (PR 10), re-expressed on
+the shared engine: attribute reads of ``.p`` / ``.q`` / ``.lam`` seed
+the per-scope fixpoint taint set, and any tainted value reaching a
+cache-backed sink is a violation — those sinks retain (process-wide
+context caches, module-level ``lru_cache``'d builders, jit executables
+the persistent compile cache may serialize, the public batched-modexp
+entries that memoize per-modulus Montgomery consts). Files under
+``dds_tpu/sanctum/`` are exempt: that package exists to hold exactly
+these computations under per-key lifetime rules.
+
+``tools/secret_lint.py`` remains the stable CLI/API for this profile
+(same exit codes, same ``Violation`` shape) and delegates here.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.argus.engine import Finding, iter_scopes, taint_scope
+
+SECRET_ATTRS = {"p", "q", "lam"}
+
+# sink -> why it is one (printed in the report)
+SINK_REASONS = {
+    "ModCtx.make": "process-wide ModCtx cache outlives every key",
+    "MxuCtx.make": "process-wide MxuCtx cache outlives every key",
+    "jax.jit": "jit argument may be baked into a persisted executable",
+    "powmod_batch": "public batched modexp caches per-modulus consts "
+                    "module-wide (use sanctum / powmod_batch_with_consts)",
+    "_chunked_powmod": "routes to backend.powmod_batch (public-parameter "
+                       "cache path)",
+    "powmod": "dds_tpu.native.powmod memoizes per-modulus Montgomery "
+              "consts module-wide (use pow() or sanctum)",
+    "fold": "dds_tpu.native.fold memoizes per-modulus Montgomery consts "
+            "module-wide",
+}
+
+# call-attribute names that are sinks regardless of the object they hang
+# off (any CryptoBackend implements powmod_batch)
+_ATTR_SINKS = {"powmod_batch"}
+# bare-name call sinks (module-level functions)
+_NAME_SINKS = {"_chunked_powmod", "powmod", "powmod_batch", "fold"}
+# <Name>.make sinks
+_MAKE_OWNERS = {"ModCtx", "MxuCtx"}
+
+EXEMPT_PARTS = ("sanctum",)  # dds_tpu/sanctum/**: the plane itself
+
+
+def _seed(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and node.attr in SECRET_ATTRS \
+            and isinstance(node.ctx, ast.Load):
+        return f"secret attribute .{node.attr}"
+    return None
+
+
+def _sink_name(call: ast.Call, lru_names: set[str]) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        owner = None
+        if isinstance(f.value, ast.Name):
+            owner = f.value.id
+        elif isinstance(f.value, ast.Attribute):  # mont_mxu.MxuCtx.make
+            owner = f.value.attr
+        if f.attr == "make" and owner in _MAKE_OWNERS:
+            return f"{owner}.make"
+        if f.attr == "jit" and isinstance(f.value, ast.Name) \
+                and f.value.id == "jax":
+            return "jax.jit"
+        if f.attr in _ATTR_SINKS:
+            return f.attr
+        if f.attr in lru_names:
+            return f.attr
+        return None
+    if isinstance(f, ast.Name):
+        if f.id in _NAME_SINKS or f.id in lru_names:
+            return f.id
+    return None
+
+
+def lru_cached_names(tree: ast.Module) -> set[str]:
+    """Names of module-level functions decorated with functools.lru_cache
+    / functools.cache (their results outlive every caller), in decorator
+    AND assignment (`fn = lru_cache(...)(impl)`) form."""
+    names: set[str] = set()
+    for stmt in tree.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in stmt.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            label = None
+            if isinstance(target, ast.Attribute):
+                label = target.attr
+            elif isinstance(target, ast.Name):
+                label = target.id
+            if label in ("lru_cache", "cache"):
+                names.add(stmt.name)
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            inner = stmt.value.func
+            if isinstance(inner, ast.Call):
+                tgt = inner.func
+                label = tgt.attr if isinstance(tgt, ast.Attribute) else (
+                    tgt.id if isinstance(tgt, ast.Name) else None)
+                if label in ("lru_cache", "cache"):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+    return names
+
+
+class SecretTaintPass:
+    pass_id = "secret"
+
+    def applies(self, rel_path: str) -> bool:
+        parts = rel_path.replace("\\", "/").split("/")
+        return not any(part in EXEMPT_PARTS for part in parts)
+
+    def run(self, tree: ast.Module, src: str, rel_path: str) -> list[Finding]:
+        lru_names = lru_cached_names(tree)
+        out: list[Finding] = []
+        for scope in iter_scopes(tree):
+            taint = taint_scope(scope, _seed)
+            from tools.argus.engine import scope_calls
+
+            for call in scope_calls(scope.body):
+                sink = _sink_name(call, lru_names)
+                if sink is None:
+                    continue
+                args = list(call.args) + [kw.value for kw in call.keywords]
+                for arg in args:
+                    tr = taint.expr_trace(arg)
+                    if tr is not None:
+                        out.append(Finding(
+                            rel_path, call.lineno, self.pass_id,
+                            "secret-flow",
+                            f"secret-derived value reaches {sink} — "
+                            f"{SINK_REASONS.get(sink, 'cache-backed sink')}",
+                            symbol=sink, scope=scope.name, trace=tr,
+                        ))
+                        break
+        return out

@@ -1,0 +1,160 @@
+"""Argus pass ``trust``: wire input must not mutate state unverified.
+
+The dependability claim rests on HMAC-signed messages and anti-replay
+nonces (PAPER.md; core/messages + utils/sigs). The bug class this pass
+freezes: a handler that takes bytes off the transport and lets them
+reach the replica repository, the proxy's stored-key set, or any other
+long-lived state without passing a verify/nonce-burn guard first —
+exactly the hole a Byzantine peer needs.
+
+Taint seeds (the shared engine's fixpoint pass, wire profile):
+
+- parameters named ``msg`` / ``payload`` / ``frame`` / ``wire`` /
+  ``body`` of an ``async def`` (transport handlers receive exactly these),
+- results of deserialization calls: ``json.loads``, ``from_wire``,
+  ``from_dict``, ``M.loads``.
+
+``match``-case captures propagate: ``case M.IWrite(key, value):`` taints
+``key`` and ``value`` when the subject is tainted.
+
+Sinks — long-lived state mutation:
+
+- subscript stores into ``repository`` / ``*store*`` / ``incoming`` /
+  ``outgoing`` attributes,
+- calls to ``_store`` / ``_install_repository`` / ``install_wire``,
+- ``.add(...)`` on a ``stored_keys``-ish set.
+
+Guard: the finding only fires when the SCOPE has no verification at all
+— no call whose name starts with ``validate``/``verify`` (or contains
+``hmac``), no ``x.verify(...)``, and no nonce-burn membership test
+against ``incoming``/``outgoing``. Scope-level (flow-insensitive) by
+design: a handler that verifies *somewhere* is reviewed by humans; a
+handler that never verifies is a machine-detectable hole. This is the
+same conservative-in-one-direction contract as the secret pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.argus.engine import (
+    Finding,
+    dotted_name,
+    iter_scopes,
+    scope_calls,
+    taint_scope,
+)
+
+WIRE_PARAMS = {"msg", "payload", "frame", "wire", "body"}
+DESERIALIZERS = {"json.loads", "from_wire", "from_dict", "loads"}
+SINK_CALLS = {"_store", "_install_repository", "install_wire"}
+STATE_ATTRS = ("repository", "store", "incoming", "outgoing")
+
+
+def _seed(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        last = name.rsplit(".", 1)[-1]
+        if name in DESERIALIZERS or last in ("from_wire", "from_dict"):
+            return f"wire deserialization {name}()"
+        if last == "loads" and name != "?":
+            return f"wire deserialization {name}()"
+    return None
+
+
+def _is_state_attr(name: str) -> bool:
+    last = name.rsplit(".", 1)[-1].lower()
+    return any(part in last for part in STATE_ATTRS)
+
+
+class TrustBoundaryPass:
+    pass_id = "trust"
+
+    def applies(self, rel_path: str) -> bool:
+        return (rel_path.startswith("dds_tpu/") or "/dds_tpu/" in rel_path
+                or "fixtures/argus" in rel_path)
+
+    def run(self, tree: ast.Module, src: str, rel_path: str) -> list[Finding]:
+        out: list[Finding] = []
+        for scope in iter_scopes(tree):
+            if scope.name == "<module>":
+                continue
+            taint = taint_scope(scope, _seed)
+            if scope.is_async:
+                for p in scope.args:
+                    if p in WIRE_PARAMS and p not in taint.traces:
+                        taint.seed_param(p, "wire-input")
+            # re-run: parameter seeds must propagate through bindings too
+            taint.run(scope.body)
+            if not taint.traces:
+                continue
+            if self._guarded(scope):
+                continue
+            out += self._sink_hits(scope, taint, rel_path)
+        return out
+
+    # -------------------------------------------------------------- guards
+
+    @staticmethod
+    def _guarded(scope) -> bool:
+        for call in scope_calls(scope.body):
+            name = dotted_name(call.func)
+            last = name.rsplit(".", 1)[-1].lower()
+            if last.startswith(("validate", "verify")) or "hmac" in last:
+                return True
+        for stmt in ast.walk(scope.node):
+            # nonce burn / replay check: `nonce in self.incoming`
+            if isinstance(stmt, ast.Compare) and any(
+                    isinstance(op, (ast.In, ast.NotIn)) for op in stmt.ops):
+                for cmp in stmt.comparators:
+                    if _is_state_attr(dotted_name(cmp)):
+                        return True
+        return False
+
+    # --------------------------------------------------------------- sinks
+
+    def _sink_hits(self, scope, taint, rel_path: str) -> list[Finding]:
+        out = []
+        # subscript stores into state attributes
+        for stmt in ast.walk(scope.node):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for tgt in stmt.targets:
+                if not (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.ctx, ast.Store)):
+                    continue
+                owner = dotted_name(tgt.value)
+                if not _is_state_attr(owner):
+                    continue
+                tr = (taint.expr_trace(tgt.slice)
+                      or taint.expr_trace(stmt.value))
+                if tr is not None:
+                    out.append(Finding(
+                        rel_path, stmt.lineno, self.pass_id,
+                        "unverified-store",
+                        f"wire-derived value stored into {owner}[...] in "
+                        f"{scope.name} with no verify/nonce guard in scope",
+                        symbol=owner, scope=scope.name, trace=tr,
+                    ))
+        # sink calls
+        for call in scope_calls(scope.body):
+            name = dotted_name(call.func)
+            last = name.rsplit(".", 1)[-1]
+            is_sink = last in SINK_CALLS or (
+                last == "add" and "stored_keys" in name
+            )
+            if not is_sink:
+                continue
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            for arg in args:
+                tr = taint.expr_trace(arg)
+                if tr is not None:
+                    out.append(Finding(
+                        rel_path, call.lineno, self.pass_id,
+                        "unverified-store",
+                        f"wire-derived value reaches {name}() in "
+                        f"{scope.name} with no verify/nonce guard in scope",
+                        symbol=name, scope=scope.name, trace=tr,
+                    ))
+                    break
+        return out

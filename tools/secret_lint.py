@@ -3,34 +3,28 @@
 
 Flags any flow of PaillierKey/RsaMultKey-derived secrets — values read
 from a ``.p`` / ``.q`` / ``.lam`` attribute, and everything computed from
-them — into machinery whose lifetime or residency outlives the key:
+them — into machinery whose lifetime or residency outlives the key
+(process-wide ModCtx/MxuCtx caches, module-level ``lru_cache``'d
+builders, ``jax.jit`` arguments, the cached public batched-modexp entry
+points). Files under ``dds_tpu/sanctum/`` are exempt — that package
+exists to hold exactly these computations under per-key lifetime rules.
 
-- ``ModCtx.make(...)`` / ``MxuCtx.make(...)``: process-wide context
-  caches (entries never die with a key);
-- any module-level ``functools.lru_cache``'d builder defined in the same
-  file (detected from its decorators);
-- ``jax.jit(...)`` arguments (a jitted builder call with a secret
-  argument bakes it into an executable the persistent compile cache may
-  serialize);
-- the public batched-modexp entry points that provably route into those
-  caches in this repo: ``<backend>.powmod_batch(...)``,
-  ``_chunked_powmod(...)``, and ``dds_tpu.native``'s cached ``powmod`` /
-  ``powmod_batch`` / ``fold`` (their per-modulus Montgomery consts
-  memoize module-wide; the consts-passing ``powmod_batch_with_consts``
-  twin is the sanctioned alternative and is NOT a sink).
+This tool pioneered the per-scope fixpoint taint pass; the machinery now
+lives in the shared Argus engine (``tools/argus``), where the same
+analysis runs as the ``secret`` pass next to the async-hazard,
+dispatch-hygiene and trust-boundary passes. This module remains the
+stable entry point the Sanctum tier-1 tests and docs reference: the
+``Violation`` shape (with its ``.sink`` attribute), ``lint_source`` /
+``lint_paths`` / ``lint_repo``, the default root set (tests/ included —
+leak *fixtures* there live in strings, not code), and the exit-code
+contract are unchanged. See ``tools/argus/passes/secret_taint.py`` for
+the seed/sink catalog and ``python -m tools.argus`` for the full suite.
 
-Files under ``dds_tpu/sanctum/`` are exempt — that package exists to
-hold exactly these computations under per-key lifetime rules.
-
-The analysis is a per-function (and per-module-body) taint pass:
-attribute reads named ``p``/``q``/``lam`` seed the taint set; assignments
-propagate it (tuple targets matched elementwise) to a fixpoint, so
-``p2 = p * p`` and list comprehensions over tainted names are tracked.
-It is deliberately intra-procedural and conservative in ONE direction:
-it can miss cross-function flows (the sink list above closes the known
-ones), but a clean report means no syntactic secret flow into a shared
-cache exists — which is the regression class this tool exists to
-freeze out (ADVICE.md round-5 medium finding; the original
+The analysis is deliberately intra-procedural and conservative in ONE
+direction: it can miss cross-function flows (the sink list closes the
+known ones), but a clean report means no syntactic secret flow into a
+shared cache exists — the regression class this tool freezes out
+(ADVICE.md round-5 medium finding; the original
 ``decrypt_batch(backend=...)`` pattern is the canonical fixture in
 tests/test_sanctum.py).
 
@@ -41,41 +35,30 @@ Exit status: 0 clean, 1 violations (printed one per line), 2 bad usage.
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 from dataclasses import dataclass
 
-SECRET_ATTRS = {"p", "q", "lam"}
+if __package__ in (None, ""):
+    # script mode (`python tools/secret_lint.py`): sys.path[0] is tools/,
+    # so the repo root that holds the `tools` package must be added
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# sink -> why it is one (printed in the report)
-SINK_REASONS = {
-    "ModCtx.make": "process-wide ModCtx cache outlives every key",
-    "MxuCtx.make": "process-wide MxuCtx cache outlives every key",
-    "jax.jit": "jit argument may be baked into a persisted executable",
-    "powmod_batch": "public batched modexp caches per-modulus consts "
-                    "module-wide (use sanctum / powmod_batch_with_consts)",
-    "_chunked_powmod": "routes to backend.powmod_batch (public-parameter "
-                       "cache path)",
-    "powmod": "dds_tpu.native.powmod memoizes per-modulus Montgomery "
-              "consts module-wide (use pow() or sanctum)",
-    "fold": "dds_tpu.native.fold memoizes per-modulus Montgomery consts "
-            "module-wide",
-}
-
-# call-attribute names that are sinks regardless of the object they hang
-# off (any CryptoBackend implements powmod_batch)
-_ATTR_SINKS = {"powmod_batch"}
-# bare-name call sinks (module-level functions)
-_NAME_SINKS = {"_chunked_powmod", "powmod", "powmod_batch", "fold"}
-# <Name>.make sinks
-_MAKE_OWNERS = {"ModCtx", "MxuCtx"}
+from tools.argus.engine import lint_source as _engine_lint_source
+from tools.argus.passes.secret_taint import (  # noqa: F401  (re-exports)
+    EXEMPT_PARTS,
+    SECRET_ATTRS,
+    SINK_REASONS,
+    SecretTaintPass,
+)
 
 # default scan roots, relative to the repo root (tests/ is scanned too:
 # leak *fixtures* there live in strings, not code)
 DEFAULT_ROOTS = ("dds_tpu", "benchmarks", "tools", "tests", "bench.py", "run.py")
 
-EXEMPT_PARTS = ("sanctum",)  # dds_tpu/sanctum/**: the plane itself
+# the Argus fixture corpora are deliberate violations-as-files; the repo
+# gate must not trip on its own test corpus
+_SKIP_MARKER = "fixtures/argus"
 
 
 @dataclass(frozen=True)
@@ -90,198 +73,38 @@ class Violation:
                 f"{self.sink} — {SINK_REASONS.get(self.sink, self.detail)}")
 
 
-def _names_in(node: ast.AST) -> set[str]:
-    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
-
-
-def _has_secret_attr(node: ast.AST) -> bool:
-    return any(
-        isinstance(n, ast.Attribute) and n.attr in SECRET_ATTRS
-        and isinstance(n.ctx, ast.Load)
-        for n in ast.walk(node)
-    )
-
-
-def _is_tainted(node: ast.AST, tainted: set[str]) -> bool:
-    return _has_secret_attr(node) or bool(_names_in(node) & tainted)
-
-
-def _assign_targets(stmt: ast.stmt):
-    """(target, value) pairs for every binding statement form we track,
-    with tuple-to-tuple assignments split elementwise."""
-    pairs = []
-    if isinstance(stmt, ast.Assign):
-        for tgt in stmt.targets:
-            pairs.append((tgt, stmt.value))
-    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
-        pairs.append((stmt.target, stmt.value))
-    elif isinstance(stmt, ast.AugAssign):
-        pairs.append((stmt.target, stmt.value))
-    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
-        pairs.append((stmt.target, stmt.iter))
-    out = []
-    for tgt, val in pairs:
-        if (isinstance(tgt, (ast.Tuple, ast.List))
-                and isinstance(val, (ast.Tuple, ast.List))
-                and len(tgt.elts) == len(val.elts)):
-            out.extend(zip(tgt.elts, val.elts))
-        else:
-            out.append((tgt, val))
-    return out
-
-
-def _walked_stmts(body: list[ast.stmt], *, into_defs: bool):
-    """All statements in `body`, descending into compound statements but
-    NOT into nested function/class definitions (each gets its own scope
-    pass) unless into_defs."""
-    for stmt in body:
-        yield stmt
-        for child in ast.iter_child_nodes(stmt):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef)) and not into_defs:
-                continue
-            if isinstance(child, ast.stmt):
-                yield from _walked_stmts([child], into_defs=into_defs)
-
-
-def _scope_taint(body: list[ast.stmt]) -> set[str]:
-    """Fixpoint taint set of local names bound (directly or transitively)
-    from secret attributes within one scope."""
-    tainted: set[str] = set()
-    changed = True
-    while changed:
-        changed = False
-        for stmt in _walked_stmts(body, into_defs=False):
-            for tgt, val in _assign_targets(stmt):
-                if not _is_tainted(val, tainted):
-                    continue
-                for n in ast.walk(tgt):
-                    if isinstance(n, ast.Name) and n.id not in tainted:
-                        tainted.add(n.id)
-                        changed = True
-                # walrus inside the value side
-            for n in ast.walk(stmt):
-                if isinstance(n, ast.NamedExpr) and _is_tainted(n.value, tainted):
-                    if isinstance(n.target, ast.Name) and n.target.id not in tainted:
-                        tainted.add(n.target.id)
-                        changed = True
-    return tainted
-
-
-def _sink_name(call: ast.Call, lru_names: set[str]) -> str | None:
-    f = call.func
-    if isinstance(f, ast.Attribute):
-        owner = None
-        if isinstance(f.value, ast.Name):
-            owner = f.value.id
-        elif isinstance(f.value, ast.Attribute):  # mont_mxu.MxuCtx.make
-            owner = f.value.attr
-        if f.attr == "make" and owner in _MAKE_OWNERS:
-            return f"{owner}.make"
-        if f.attr == "jit" and isinstance(f.value, ast.Name) \
-                and f.value.id == "jax":
-            return "jax.jit"
-        if f.attr in _ATTR_SINKS:
-            return f.attr
-        if f.attr in lru_names:
-            return f.attr
-        return None
-    if isinstance(f, ast.Name):
-        if f.id in _NAME_SINKS or f.id in lru_names:
-            return f.id
-        if f.id == "jit":
-            return None  # bare `jit` is not imported anywhere we scan
-    return None
-
-
-def _lru_cached_names(tree: ast.Module) -> set[str]:
-    """Names of module-level functions decorated with functools.lru_cache
-    / functools.cache (their results outlive every caller)."""
-    names: set[str] = set()
-    for stmt in tree.body:
-        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        for dec in stmt.decorator_list:
-            target = dec.func if isinstance(dec, ast.Call) else dec
-            label = None
-            if isinstance(target, ast.Attribute):
-                label = target.attr
-            elif isinstance(target, ast.Name):
-                label = target.id
-            if label in ("lru_cache", "cache"):
-                names.add(stmt.name)
-    # assignment form: fn = functools.lru_cache(...)(impl)
-    for stmt in tree.body:
-        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
-            inner = stmt.value.func
-            if isinstance(inner, ast.Call):
-                tgt = inner.func
-                label = tgt.attr if isinstance(tgt, ast.Attribute) else (
-                    tgt.id if isinstance(tgt, ast.Name) else None)
-                if label in ("lru_cache", "cache"):
-                    for t in stmt.targets:
-                        if isinstance(t, ast.Name):
-                            names.add(t.id)
-    return names
-
-
-def _check_scope(body: list[ast.stmt], lru_names: set[str], path: str,
-                 out: list[Violation]) -> None:
-    tainted = _scope_taint(body)
-    for stmt in _walked_stmts(body, into_defs=False):
-        for node in ast.walk(stmt):
-            if not isinstance(node, ast.Call):
-                continue
-            sink = _sink_name(node, lru_names)
-            if sink is None:
-                continue
-            args = list(node.args) + [kw.value for kw in node.keywords]
-            for arg in args:
-                if _is_tainted(arg, tainted):
-                    out.append(Violation(
-                        path, node.lineno, sink,
-                        "secret-derived argument",
-                    ))
-                    break
+def _to_violation(finding) -> Violation:
+    if finding.pass_id == "parse":
+        return Violation(finding.path, finding.line, "syntax-error",
+                         finding.message)
+    return Violation(finding.path, finding.line, finding.symbol,
+                     "secret-derived argument")
 
 
 def lint_source(src: str, path: str = "<string>") -> list[Violation]:
     """Lint one python source text; returns violations (possibly empty)."""
-    tree = ast.parse(src, filename=path)
-    lru_names = _lru_cached_names(tree)
-    out: list[Violation] = []
-    # module body, then every function/method scope independently
-    _check_scope(tree.body, lru_names, path, out)
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            _check_scope(node.body, lru_names, path, out)
-    # dedupe (a call can be reached from module + function walks)
-    seen: set[tuple] = set()
-    uniq = []
-    for v in out:
-        k = (v.path, v.line, v.sink)
-        if k not in seen:
-            seen.add(k)
-            uniq.append(v)
-    return uniq
+    findings = _engine_lint_source(src, path, [SecretTaintPass()])
+    return [_to_violation(f) for f in findings]
 
 
-def _is_exempt(path: pathlib.Path) -> bool:
-    return any(part in EXEMPT_PARTS for part in path.parts)
+def _is_exempt(path: pathlib.Path, *, walking: bool) -> bool:
+    if any(part in EXEMPT_PARTS for part in path.parts):
+        return True
+    # fixture corpora are only skipped during directory walks (the repo
+    # gate); a file named explicitly on the CLI is always linted
+    return walking and _SKIP_MARKER in str(path).replace("\\", "/")
 
 
 def lint_paths(paths: list[pathlib.Path]) -> list[Violation]:
     out: list[Violation] = []
     for root in paths:
-        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        walking = root.is_dir()
+        files = sorted(root.rglob("*.py")) if walking else [root]
         for f in files:
-            if _is_exempt(f.relative_to(root) if root.is_dir() else f):
+            if _is_exempt(f.relative_to(root) if walking else f,
+                          walking=walking):
                 continue
-            try:
-                out.extend(lint_source(f.read_text(), str(f)))
-            except SyntaxError as e:
-                out.append(Violation(str(f), e.lineno or 0, "syntax-error",
-                                     str(e)))
+            out.extend(lint_source(f.read_text(), str(f)))
     return out
 
 
