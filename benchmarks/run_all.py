@@ -21,8 +21,8 @@ def main(argv=None):
     from benchmarks import (analytics_matvec, audit_cost, bft_sum, crossover,
                             decrypt_throughput, encrypt_modexp, mixed,
                             multihost_load, overload_goodput, product,
-                            put_concurrency, resident_fold, shard_scaling,
-                            sweep)
+                            put_concurrency, resident_fold, search_latency,
+                            shard_scaling, sweep)
 
     rows = []
     if args.quick:
@@ -50,6 +50,7 @@ def main(argv=None):
         rows += decrypt_throughput.main(
             ["--bits", "512", "--b", "48", "--repeats", "1"]
         )
+        rows += search_latency.main(["--keys", "32", "--repeats", "2"])
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -65,6 +66,7 @@ def main(argv=None):
         rows += multihost_load.main([])
         rows += resident_fold.main([])
         rows += decrypt_throughput.main([])
+        rows += search_latency.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

@@ -1,0 +1,170 @@
+"""Spyglass search-latency benchmark: indexed routes vs the legacy scan.
+
+The structural claim of ISSUE 13: a warm `Search*`/`Order*`/`Range`
+query should cost ONE batched tag-validation quorum round plus one
+predicate kernel dispatch (ops/predicate over the SearchPlane's packed
+columns), not a full keyspace materialization. The legacy scan — the
+reference's `DDSRestServer.scala:397-446` shape, which re-reads every
+stored set quorum-deep per query — pays O(N) ABD value rounds before
+its host filter loop even starts.
+
+The harness launches the SAME store twice and drives identical query
+streams end-to-end through the REST edge:
+
+- legacy  — search disabled AND the tag-validated aggregate cache
+  disabled: every query re-fetches the whole keyspace through full ABD
+  reads, exactly the reference's cache-less scan (the path Spyglass
+  replaces);
+- indexed — `[search] enabled` (cache on): warm queries validate the
+  index with one `read_tags` round and answer from the packed columns.
+
+Both deployments are seeded with the same value rows (distinct ints at
+position 0, a DET-style label at position 1), and every op's keysets
+are mapped back to row ids and checked EQUAL across deployments before
+any timing (the equality gate). One `search latency` record per op
+lands in results.json via benchmarks/common.emit() (value = indexed
+queries/s, vs_baseline = legacy_ms / indexed_ms, >1 = indexed wins).
+benchmarks/sentry.py --check validates the records.
+
+Usage: python -m benchmarks.search_latency [--keys 96] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import time
+
+from benchmarks.common import emit
+
+
+def _config(args, indexed: bool):
+    from dds_tpu.utils.config import DDSConfig
+
+    cfg = DDSConfig()
+    cfg.replicas.endpoints = [f"replica-{i}" for i in range(4)]
+    cfg.replicas.sentinent = []
+    cfg.replicas.byz_quorum_size = 3
+    cfg.replicas.byz_max_faults = 1
+    cfg.proxy.port = 0
+    # quiet fabric: the bench measures query paths, not recovery timers
+    cfg.recovery.enabled = False
+    cfg.obs.audit_enabled = False
+    cfg.search.enabled = indexed
+    return cfg
+
+
+async def _seed(host: str, port: int, rows: list[list]) -> dict[str, int]:
+    from dds_tpu.http.miniserver import http_request
+
+    key_to_row: dict[str, int] = {}
+    for i, row in enumerate(rows):
+        status, body = await http_request(
+            host, port, "POST", "/PutSet",
+            json.dumps({"contents": row}).encode(), timeout=10.0,
+        )
+        if status != 200:
+            raise RuntimeError(f"store seeding failed with {status}")
+        key_to_row[body.decode()] = i
+    return key_to_row
+
+
+async def _drive(args) -> list[dict]:
+    from dds_tpu.http.miniserver import http_request
+    from dds_tpu.run import launch
+
+    rng = random.Random(args.seed)
+    # distinct position-0 ints: cross-deployment keysets compare by row
+    # id without tie-order ambiguity (keys are server-assigned)
+    vals = rng.sample(range(1, 1 << 40), args.keys)
+    rows = [[v, f"city{i % 7}"] for i, v in enumerate(vals)]
+    thr = sorted(vals)[args.keys // 2]
+    lo_b, hi_b = sorted(vals)[args.keys // 4], sorted(vals)[3 * args.keys // 4]
+
+    cases = [
+        ("gt", "POST", "/SearchGt?position=0", {"value": thr}),
+        ("eq", "POST", "/SearchEq?position=1", {"value": "city3"}),
+        ("order", "GET", "/OrderLS?position=0", None),
+        ("range", "POST", "/Range?position=0",
+         {"value1": lo_b, "value2": hi_b}),
+    ]
+
+    async def run_variant(indexed: bool) -> dict:
+        dep = await launch(_config(args, indexed))
+        if not indexed:
+            # legacy = the reference's cache-less scan: full keyspace ABD
+            # value reads per query (the cost Spyglass's one-round
+            # validation replaces). The tag-validated aggregate cache is
+            # a later addition the reference never had — off, so the
+            # baseline is the true `DDSRestServer.scala` shape.
+            dep.server.cfg.aggregate_cache = False
+        host, port = "127.0.0.1", dep.server.cfg.port
+        key_to_row = await _seed(host, port, rows)
+
+        async def query(method, target, obj) -> list[int]:
+            body = json.dumps(obj).encode() if obj is not None else None
+            status, out = await http_request(
+                host, port, method, target, body, timeout=30.0,
+            )
+            if status != 200:
+                raise RuntimeError(f"{target} answered {status}")
+            return [key_to_row[k] for k in json.loads(out)["keyset"]]
+
+        results: dict[str, list[int]] = {}
+        timings: dict[str, float] = {}
+        for op, method, target, obj in cases:
+            # warm pass: pack build + kernel compile (indexed) / cache
+            # symmetry (legacy); its result is the equality-gate operand
+            results[op] = await query(method, target, obj)
+            best = []
+            for _ in range(args.repeats):
+                t0 = time.perf_counter()
+                got = await query(method, target, obj)
+                best.append(time.perf_counter() - t0)
+                assert got == results[op], f"{op} answered unstably"
+            timings[op] = min(best) * 1e3
+        await dep.stop()
+        return {"results": results, "timings": timings}
+
+    legacy = await run_variant(indexed=False)
+    indexed = await run_variant(indexed=True)
+
+    out = []
+    for op, _, _, _ in cases:
+        # equality gate: the indexed route must select exactly the rows
+        # the legacy scan selects, in the same order (row-id mapped —
+        # keys are per-deployment)
+        want, got = legacy["results"][op], indexed["results"][op]
+        assert got == want, f"indexed {op} diverged from the legacy scan"
+        out.append({
+            "op": op,
+            "rows": args.keys,
+            "hits": len(want),
+            "legacy_ms": legacy["timings"][op],
+            "indexed_ms": indexed["timings"][op],
+        })
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--keys", type=int, default=96)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=13)
+    args = ap.parse_args(argv)
+
+    rows = []
+    for d in asyncio.run(_drive(args)):
+        rows.append(emit(
+            f"search latency ({d['op']}, N={d['rows']})",
+            1e3 / d["indexed_ms"], "queries/s",
+            d["legacy_ms"] / d["indexed_ms"],  # >1 = indexed beats the scan
+            **d,
+        ))
+    return rows
+
+
+if __name__ == "__main__":
+    main()

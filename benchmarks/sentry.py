@@ -10,11 +10,12 @@ Modes (all emit one JSON line to stdout):
         `analytics matvec` (benchmarks/analytics_matvec.py),
         `overload goodput` (benchmarks/overload_goodput.py),
         `multihost load` (benchmarks/multihost_load.py),
-        `resident fold` (benchmarks/resident_fold.py) and
-        `decrypt throughput` (benchmarks/decrypt_throughput.py) records
+        `resident fold` (benchmarks/resident_fold.py),
+        `decrypt throughput` (benchmarks/decrypt_throughput.py) and
+        `search latency` (benchmarks/search_latency.py) records
         in benchmarks/results.json / results_quick.json so a malformed
-        scaling, analytics, overload, multihost, resident or decrypt
-        record is caught by the same smoke.
+        scaling, analytics, overload, multihost, resident, decrypt or
+        search record is caught by the same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -215,6 +216,38 @@ def _check_resident_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_search_records(root: str = REPO) -> dict:
+    """Validate `search latency` rows (benchmarks/search_latency.py):
+    positive queries/s value and a detail block naming the op, the store
+    size, the hit count, and positive indexed/legacy timings (the
+    indexed-vs-scan comparison the record exists for). Same malformed
+    contract as the other row families: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("search latency")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("op"), str) and detail["op"]
+            and isinstance(detail.get("rows"), int) and detail["rows"] >= 1
+            and isinstance(detail.get("hits"), int) and detail["hits"] >= 0
+            and isinstance(detail.get("indexed_ms"), (int, float))
+            and detail["indexed_ms"] > 0
+            and isinstance(detail.get("legacy_ms"), (int, float))
+            and detail["legacy_ms"] > 0
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed search-latency record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _check_multihost_records(root: str = REPO) -> dict:
     """Validate `multihost load` rows (benchmarks/multihost_load.py):
     positive good-req/s value, a detail block naming the swept rates, the
@@ -333,6 +366,7 @@ def main(argv=None) -> int:
             multihost = _check_multihost_records()
             resident = _check_resident_records()
             decrypt = _check_decrypt_records()
+            search = _check_search_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -346,6 +380,7 @@ def main(argv=None) -> int:
             "multihost_rows": multihost["rows"],
             "resident_rows": resident["rows"],
             "decrypt_rows": decrypt["rows"],
+            "search_rows": search["rows"],
         }))
         return 0
 

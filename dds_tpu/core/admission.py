@@ -63,7 +63,7 @@ _INTERACTIVE = frozenset({
     "WriteElement", "IsElement", "Sum", "Mult",
 })
 _AGGREGATE = frozenset({
-    "SumAll", "MultAll", "OrderLS", "OrderSL",
+    "SumAll", "MultAll", "OrderLS", "OrderSL", "Range",
     "SearchEq", "SearchNEq", "SearchGt", "SearchGtEq", "SearchLt",
     "SearchLtEq", "SearchEntry", "SearchEntryOR", "SearchEntryAND",
     "MatVec", "WeightedSum", "GroupBySum",

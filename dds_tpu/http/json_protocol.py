@@ -45,6 +45,14 @@ def parse_triplet(obj) -> tuple:
     return obj["value1"], obj["value2"], obj["value3"]
 
 
+def parse_range(obj) -> tuple[int, int]:
+    """POST /Range body: {'value1': lo, 'value2': hi} — inclusive int
+    bounds (decimal strings accepted, like every Search* item)."""
+    if not isinstance(obj, dict) or not all(f"value{i}" in obj for i in (1, 2)):
+        raise ValueError("expected {'value1': lo, 'value2': hi}")
+    return int(obj["value1"]), int(obj["value2"])
+
+
 def parse_keys(obj) -> list[str]:
     if not isinstance(obj, dict) or not isinstance(obj.get("keyset"), list):
         raise ValueError("expected {'keyset': [...]}")

@@ -210,6 +210,12 @@ class ProxyConfig:
     # gather+fold dispatch instead of S per-group marshaling folds.
     # None/disabled = the pre-Lodestone paths exactly.
     resident: object = None
+    # Spyglass encrypted search plane (dds_tpu/search): a SearchConfig-
+    # shaped object with enabled=True serves Search*/Order*/Range from
+    # per-group device-resident DET/OPE column indexes — ONE batched tag
+    # round + one predicate kernel dispatch per query instead of the
+    # legacy full-keyspace scan. None/disabled = the legacy scan exactly.
+    search: object = None
     # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
     replica_refresh_interval: float = 5.0
     supervisor: Optional[str] = None
@@ -326,6 +332,30 @@ class DDSRestServer:
             if group_ids is not None:
                 # deterministic group -> mesh-slice placement up front
                 self._resident.register_groups(group_ids())
+        # Spyglass (dds_tpu/search): per-group search indexes over the
+        # DET/OPE column families, written from the request path (queued,
+        # debounced — the Lodestone ingest pattern) and validated per
+        # query with one batched read_tags round. None when disabled —
+        # every Search*/Order*/Range gate below is a cheap is-None check
+        # that falls through to the legacy scan.
+        scfg = self.cfg.search
+        self._search = None
+        self._search_write_ingest = False
+        self._search_ingest_window = 0.005
+        self._search_ingest_task: asyncio.Task | None = None
+        if scfg is not None and getattr(scfg, "enabled", False):
+            from dds_tpu.search import SearchPlane
+
+            self._search = SearchPlane(
+                max_pending=getattr(scfg, "max_pending", 8192)
+            )
+            self._search_write_ingest = getattr(scfg, "write_ingest", True)
+            self._search_ingest_window = max(
+                0.0, getattr(scfg, "ingest_window", 0.005)
+            )
+            group_ids = getattr(self.abd, "group_ids", None)
+            if group_ids is not None:
+                self._search.register_groups(group_ids())
         # Prism analytics engine (analytics/prism): same backend, same
         # public-parameter boundary; sharded proxies hand it the router's
         # owner resolver so weighted folds scatter-gather like SumAll,
@@ -405,6 +435,9 @@ class DDSRestServer:
         if self._ingest_task is not None:
             await _cancel_task(self._ingest_task)
             self._ingest_task = None
+        if self._search_ingest_task is not None:
+            await _cancel_task(self._search_ingest_task)
+            self._search_ingest_task = None
         if self._keys_saver is not None:
             await _cancel_task(self._keys_saver)
             self._keys_saver = None
@@ -570,6 +603,11 @@ class DDSRestServer:
     def _flush_cache(self) -> None:
         self._cache.clear()
         self._cache_version += 1
+        if self._search is not None:
+            # the search index inherits the cache's completed-op trust
+            # argument, so an audit-triggered flush voids it too: the next
+            # query rebuilds every entry from full quorum reads
+            self._search.invalidate()
 
     def _note_stored(self, key: str) -> None:
         if key not in self.stored_keys:
@@ -610,6 +648,7 @@ class DDSRestServer:
         )
         self._cache_put(key, tag, value)
         self._note_resident_write(key, value)
+        self._note_search_write(key, tag, value)
         return k
 
     # --------------------------------------------- Lodestone write ingest
@@ -656,6 +695,311 @@ class DDSRestServer:
 
         self._ingest_task = supervised_task(_drain(),
                                             name="proxy.resident_ingest")
+
+    # ----------------------------------------- Spyglass encrypted search
+
+    def _note_search_write(self, key: str, tag, value) -> None:
+        """Queue a committed write's (tag, value) for search-index upsert
+        (dds_tpu/search) — OFF the request path, like the resident
+        ingest. value None (RemoveSet) becomes a tombstone so the index
+        never resurrects a deleted record. A full queue is safe: the key
+        just reads stale at the next query and is repaired there."""
+        plane = self._search
+        if plane is None or not self._search_write_ingest:
+            return
+        gid = self.abd.owner(key) if self._shards is not None else ""
+        if plane.note_write(gid, key, tag, value):
+            self._search_ingest_soon()
+
+    def _search_ingest_soon(self) -> None:
+        """Debounced drain, one task at a time (the _resident_ingest_soon
+        pattern): coalesce a write burst into few index-upsert batches on
+        a worker thread."""
+        if (self._search_ingest_task is not None
+                and not self._search_ingest_task.done()):
+            return
+        # capture the plane: the drain sleeps between batches, and the
+        # attribute can be unplugged (shutdown, tests) while it does
+        plane = self._search
+
+        async def _drain():
+            while plane.pending_ingest():
+                await asyncio.sleep(self._search_ingest_window)
+                await asyncio.to_thread(plane.ingest_pending)
+
+        self._search_ingest_task = supervised_task(
+            _drain(), name="proxy.search_ingest"
+        )
+
+    def _search_owner(self, key: str) -> str:
+        return self.abd.owner(key) if self._shards is not None else ""
+
+    async def _spy_validate(self) -> list[str]:
+        """Freshness for one indexed query: validate every stored key's
+        index entry with ONE batched `read_tags` fingerprint round (the
+        `_fetch_stored` linearizability argument verbatim — entries come
+        from completed quorum ops, and honest replies can never deflate
+        the quorum-max tag below a completed write). Only stale or
+        missing keys take full ABD reads, re-ingesting as they land.
+        Returns the sorted stored keys; afterwards every one has a
+        validated index entry, so indexed results are bit-for-bit the
+        legacy scan's."""
+        plane = self._search
+        keys = sorted(self.stored_keys)
+        if not keys:
+            return keys
+        cached: list[str] = []
+        cached_tags: list = []
+        missing: list[str] = []
+        for k in keys:
+            t = plane.tag(self._search_owner(k), k)
+            if t is None:
+                missing.append(k)
+            else:
+                cached.append(k)
+                cached_tags.append(t)
+        stale = list(missing)
+        if cached:
+            try:
+                dl = self._request_deadline()
+                digest = sigs.key_from_set(cached)
+                fp = sigs.tags_fingerprint(cached_tags)
+                tags = await self._retry(
+                    lambda: self.abd.read_tags(
+                        cached, digest=digest, fingerprint=fp,
+                        cached_tags=cached_tags, deadline=dl,
+                    ),
+                    dl,
+                )
+                if tags is not cached_tags:
+                    # identity return = every vote said "unchanged";
+                    # otherwise compare per key
+                    stale.extend(
+                        k for k, t, ct in zip(cached, tags, cached_tags)
+                        if t != ct
+                    )
+            except Exception as e:  # validation trouble => full refetch
+                log.debug("search tag validation failed (%s); refetch", e)
+                stale = list(keys)
+        if stale:
+            results = await asyncio.gather(
+                *(self._fetch_tagged(k) for k in stale),
+                return_exceptions=True,
+            )
+            for k, r in zip(stale, results):
+                if isinstance(r, Exception):
+                    raise r
+                value, tag, _coord = r
+                plane.upsert(self._search_owner(k), k, tag, value)
+        metrics.inc(
+            "dds_search_index_total", max(0, len(keys) - len(stale)),
+            outcome="hit", help="Spyglass index keys per query by outcome",
+        )
+        metrics.inc(
+            "dds_search_index_total", max(0, len(stale) - len(missing)),
+            outcome="stale", help="Spyglass index keys per query by outcome",
+        )
+        metrics.inc(
+            "dds_search_index_total", len(missing), outcome="miss",
+            help="Spyglass index keys per query by outcome",
+        )
+        return keys
+
+    def _spy_partition(self, keys: list[str]) -> dict[str, list[str]]:
+        """Stored keys by owning shard group (one anonymous group when
+        unsharded) — the scatter side of a query's per-group dispatch."""
+        if self._shards is None:
+            return {"": keys}
+        parts: dict[str, list[str]] = {}
+        for k in keys:
+            parts.setdefault(self.abd.owner(k), []).append(k)
+        return parts
+
+    async def _spy_filter(self, evalfn) -> list[str]:
+        """One indexed selection query: validate, dispatch `evalfn` per
+        group CONCURRENTLY (each group's predicate kernel runs on a
+        worker thread), union the key sets, and return them in
+        sorted-key order — exactly the legacy scan's output order."""
+        keys = await self._spy_validate()
+        if not keys:
+            return []
+        parts = self._spy_partition(keys)
+        with tracer.span("proxy.search_eval", k=len(keys),
+                         shards=len(parts)):
+            sets = await asyncio.gather(
+                *(
+                    asyncio.to_thread(evalfn, self._search.group(gid))
+                    for gid in parts
+                )
+            )
+        selected = set().union(*sets)
+        return [k for k in keys if k in selected]
+
+    async def _spy_order(self, pos: int, descending: bool) -> list[str]:
+        """One indexed order-by query: per-group device-sorted runs
+        merged host-side. Run elements are (comparable, key) with the
+        comparable negated for descending order, so `heapq.merge`
+        reproduces the global stable sort — ties in ascending key order,
+        like the legacy stable `sorted` over sorted-key pairs."""
+        import heapq
+
+        keys = await self._spy_validate()
+        if not keys:
+            return []
+        parts = self._spy_partition(keys)
+        with tracer.span("proxy.search_eval", k=len(keys),
+                         shards=len(parts)):
+            runs = await asyncio.gather(
+                *(
+                    asyncio.to_thread(
+                        self._search.group(gid).eval_order, pos, descending
+                    )
+                    for gid in parts
+                )
+            )
+        stored = set(keys)
+        return [k for _, k in heapq.merge(*runs) if k in stored]
+
+    @staticmethod
+    def _page_params(req: Request) -> tuple[int, int | None]:
+        """`offset`/`limit` pagination params (every search/order route,
+        both paths): non-negative ints, ValueError -> 400 via handle()."""
+        off = int(req.query.get("offset", 0))
+        if off < 0:
+            raise ValueError("offset must be >= 0")
+        lim = req.query.get("limit")
+        lim = int(lim) if lim is not None else None
+        if lim is not None and lim < 0:
+            raise ValueError("limit must be >= 0")
+        return off, lim
+
+    @staticmethod
+    def _page_response(keyset: list[str],
+                       page: tuple[int, int | None]) -> Response:
+        off, lim = page
+        end = None if lim is None else off + lim
+        return Response.json(J.keys_result(keyset[off:end]))
+
+    @staticmethod
+    def _count_search(route: str, path: str) -> None:
+        metrics.inc(
+            "dds_search_requests_total", route=route, path=path,
+            help="search/order/range requests by evaluation path",
+        )
+
+    async def _order_route(self, name: str, req: Request) -> Response:
+        pos = self._pos(req)
+        page = self._page_params(req)
+        descending = name == "OrderLS"
+        if self._search is not None:
+            self._count_search(name, "indexed")
+            return self._page_response(
+                await self._spy_order(pos, descending), page
+            )
+        self._count_search(name, "legacy")
+        pairs = await self._fetch_stored()
+        # records without the column are EXCLUDED (the Search* convention)
+        # instead of the old silent float("-inf") coercion; non-integer
+        # columns raise -> 400, like every Search* int cast
+        rows = [(int(v[pos]), k) for k, v in pairs if pos < len(v)]
+        ordered = [
+            k for _, k in
+            sorted(rows, key=lambda t: t[0], reverse=descending)
+        ]
+        return self._page_response(ordered, page)
+
+    async def _eq_route(self, name: str, req: Request) -> Response:
+        from dds_tpu.models.det import DetKey
+
+        pos = self._pos(req)
+        item = str(J.parse_item(req.json()))
+        page = self._page_params(req)
+        want_eq = name == "SearchEq"
+        if self._search is not None:
+            self._count_search(name, "indexed")
+            keyset = await self._spy_filter(
+                lambda idx: idx.eval_eq(pos, item, want_eq)
+            )
+            return self._page_response(keyset, page)
+        self._count_search(name, "legacy")
+        pairs = await self._fetch_stored()
+        keyset = [
+            k for k, v in pairs
+            if pos < len(v) and DetKey.compare(str(v[pos]), item) == want_eq
+        ]
+        return self._page_response(keyset, page)
+
+    _CMP_OPS = {"SearchGt": "gt", "SearchGtEq": "ge",
+                "SearchLt": "lt", "SearchLtEq": "le"}
+
+    async def _cmp_route(self, name: str, req: Request) -> Response:
+        pos = self._pos(req)
+        item = int(J.parse_item(req.json()))
+        page = self._page_params(req)
+        if self._search is not None:
+            self._count_search(name, "indexed")
+            keyset = await self._spy_filter(
+                lambda idx: idx.eval_compare(pos, self._CMP_OPS[name], item)
+            )
+            return self._page_response(keyset, page)
+        self._count_search(name, "legacy")
+        pairs = await self._fetch_stored()
+        op = {
+            "SearchGt": lambda e: e > item,
+            "SearchGtEq": lambda e: e >= item,
+            "SearchLt": lambda e: e < item,
+            "SearchLtEq": lambda e: e <= item,
+        }[name]
+        keyset = [k for k, v in pairs if pos < len(v) and op(int(v[pos]))]
+        return self._page_response(keyset, page)
+
+    async def _range_route(self, req: Request) -> Response:
+        pos = self._pos(req)
+        lo_bound, hi_bound = J.parse_range(req.json())
+        page = self._page_params(req)
+        if self._search is not None:
+            self._count_search("Range", "indexed")
+            keyset = await self._spy_filter(
+                lambda idx: idx.eval_range(pos, lo_bound, hi_bound)
+            )
+            return self._page_response(keyset, page)
+        self._count_search("Range", "legacy")
+        pairs = await self._fetch_stored()
+        keyset = [
+            k for k, v in pairs
+            if pos < len(v) and lo_bound <= int(v[pos]) <= hi_bound
+        ]
+        return self._page_response(keyset, page)
+
+    async def _entry_route(self, name: str, req: Request) -> Response:
+        from dds_tpu.models.det import DetKey
+
+        if name == "SearchEntry":
+            vals = [str(J.parse_item(req.json()))]
+        else:
+            vals = [str(x) for x in J.parse_triplet(req.json())]
+        mode = "all" if name == "SearchEntryAND" else "any"
+        page = self._page_params(req)
+        if self._search is not None:
+            self._count_search(name, "indexed")
+            keyset = await self._spy_filter(
+                lambda idx: idx.eval_entry(vals, mode)
+            )
+            return self._page_response(keyset, page)
+        self._count_search(name, "legacy")
+        pairs = await self._fetch_stored()
+        if mode == "all":
+            keyset = [
+                k for k, v in pairs
+                if all(any(DetKey.compare(str(e), q) for e in v)
+                       for q in vals)
+            ]
+        else:
+            keyset = [
+                k for k, v in pairs
+                if any(DetKey.compare(str(e), q) for q in vals for e in v)
+            ]
+        return self._page_response(keyset, page)
 
     async def _fetch_stored(self) -> list[tuple[str, list]]:
         """Every stored (key, value), for the aggregate/search routes.
@@ -1075,76 +1419,28 @@ class DDSRestServer:
             case ("GET", "MultAll"):
                 return await self._fold_aggregate(req, "pubkey")
 
+            # ------------- encrypted search (Spyglass indexed or legacy scan)
+
             case ("GET", "OrderLS") | ("GET", "OrderSL"):
-                pos = self._pos(req)
-                pairs = await self._fetch_stored()
-
-                def sort_key(pair):
-                    _, value = pair
-                    if pos >= len(value):
-                        return float("-inf")
-                    return int(value[pos])
-
-                ordered = sorted(pairs, key=sort_key, reverse=(name == "OrderLS"))
-                return Response.json(J.keys_result([k for k, _ in ordered]))
+                return await self._order_route(name, req)
 
             case ("POST", "SearchEq") | ("POST", "SearchNEq"):
-                pos = self._pos(req)
-                item = str(J.parse_item(req.json()))
-                pairs = await self._fetch_stored()
-                want_eq = name == "SearchEq"
-                keyset = [
-                    k
-                    for k, v in pairs
-                    if pos < len(v) and (str(v[pos]) == item) == want_eq
-                ]
-                return Response.json(J.keys_result(keyset))
+                return await self._eq_route(name, req)
 
             case ("POST", "SearchGt") | ("POST", "SearchGtEq") | (
                 "POST",
                 "SearchLt",
             ) | ("POST", "SearchLtEq"):
-                pos = self._pos(req)
-                item = int(J.parse_item(req.json()))
-                pairs = await self._fetch_stored()
-                op = {
-                    "SearchGt": lambda e: e > item,
-                    "SearchGtEq": lambda e: e >= item,
-                    "SearchLt": lambda e: e < item,
-                    "SearchLtEq": lambda e: e <= item,
-                }[name]
-                keyset = [
-                    k for k, v in pairs if pos < len(v) and op(int(v[pos]))
-                ]
-                return Response.json(J.keys_result(keyset))
+                return await self._cmp_route(name, req)
 
-            case ("POST", "SearchEntry"):
-                item = str(J.parse_item(req.json()))
-                pairs = await self._fetch_stored()
-                keyset = [
-                    k for k, v in pairs if any(str(e) == item for e in v)
-                ]
-                return Response.json(J.keys_result(keyset))
+            case ("POST", "Range"):
+                return await self._range_route(req)
 
-            case ("POST", "SearchEntryOR"):
-                vals = [str(x) for x in J.parse_triplet(req.json())]
-                pairs = await self._fetch_stored()
-                keyset = [
-                    k
-                    for k, v in pairs
-                    if any(str(e) in vals for e in v)
-                ]
-                return Response.json(J.keys_result(keyset))
-
-            case ("POST", "SearchEntryAND"):
-                vals = [str(x) for x in J.parse_triplet(req.json())]
-                pairs = await self._fetch_stored()
-                keyset = [
-                    k
-                    for k, v in pairs
-                    if all(any(str(e) == q for e in v) for q in vals)
-                ]
-                return Response.json(J.keys_result(keyset))
+            case ("POST", "SearchEntry") | ("POST", "SearchEntryOR") | (
+                "POST",
+                "SearchEntryAND",
+            ):
+                return await self._entry_route(name, req)
 
             # ---------------- Prism encrypted analytics (PC-MM) ----------------
 
@@ -1205,6 +1501,10 @@ class DDSRestServer:
                     # Lodestone surface: per-pool residency, HBM bytes,
                     # reset churn, and the pending write-ingest queue
                     health["resident"] = self._resident.stats()
+                if self._search is not None:
+                    # Spyglass surface: per-group indexed keys/packs and
+                    # the pending ingest queue
+                    health["search"] = self._search.stats()
                 recovery = self._recovery_status()
                 if recovery is not None:
                     health["recovery"] = recovery
@@ -1371,6 +1671,10 @@ class DDSRestServer:
             # Lodestone gauges: dds_resident_{rows,bytes,hit_ratio,
             # resets}{shard=...}, aggregated per group at scrape time
             self._resident.export_gauges(metrics)
+        if self._search is not None:
+            # Spyglass gauges: dds_search_{index_keys,index_packs,
+            # pending_ingest,...}, per group at scrape time
+            self._search.export_gauges(metrics)
         # SLO burn/budget gauges + audit backlog (scrape-time freshness is
         # all a gauge promises; the violation COUNTER increments at
         # detection time in the auditor itself)

@@ -42,5 +42,13 @@ class DetKey:
 
     @staticmethod
     def compare(c1: str, c2: str) -> bool:
-        """Ciphertext-domain equality — what the proxy runs."""
-        return c1 == c2
+        """Ciphertext-domain equality — what the proxy runs.
+
+        Constant-time (`hmac.compare_digest`): both operands are
+        attacker-influenced strings compared on the proxy, and a
+        short-circuiting `==` would leak the length of the common prefix
+        through timing. The scheme's leakage profile is unchanged —
+        deterministic encryption reveals equality of ciphertexts by
+        design, and equality (plus nothing positional) is still all this
+        comparison reveals."""
+        return hmac.compare_digest(c1.encode(), c2.encode())

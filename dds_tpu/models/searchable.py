@@ -51,5 +51,14 @@ class SearchKey:
 
     @staticmethod
     def matches(payload: str, trapdoor: str) -> bool:
-        """Ciphertext-domain word test — runs without any key."""
-        return trapdoor in payload.split(".")[2:]
+        """Ciphertext-domain word test — runs without any key.
+
+        Each tag is checked with `hmac.compare_digest` and the scan never
+        short-circuits: `trapdoor in tags` would leak which tag slot
+        matched (and the length of common prefixes) through timing on the
+        untrusted searcher. The leakage profile stays what the scheme
+        promises — whether SOME tag equals the trapdoor, nothing more."""
+        found = False
+        for tag in payload.split(".")[2:]:
+            found |= hmac.compare_digest(tag.encode(), trapdoor.encode())
+        return found

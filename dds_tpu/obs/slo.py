@@ -28,7 +28,38 @@ import threading
 import time
 from dataclasses import dataclass
 
-__all__ = ["RouteSlo", "SloEngine"]
+__all__ = ["RouteSlo", "SloEngine", "slo_class"]
+
+# The Spyglass-served encrypted query surface (Search*/Order*/Range):
+# classified as its own SLO family so operators can budget the indexed
+# query plane separately from the fold aggregates it used to hide behind.
+_SEARCH_ROUTES = frozenset({
+    "OrderLS", "OrderSL", "Range",
+    "SearchEq", "SearchNEq", "SearchGt", "SearchGtEq", "SearchLt",
+    "SearchLtEq", "SearchEntry", "SearchEntryOR", "SearchEntryAND",
+})
+_AGGREGATE_ROUTES = frozenset({"Sum", "Mult", "SumAll", "MultAll"})
+_ANALYTICS_ROUTES = frozenset({"MatVec", "WeightedSum", "GroupBySum"})
+_POINT_ROUTES = frozenset({
+    "GetSet", "PutSet", "RemoveSet", "AddElement", "ReadElement",
+    "WriteElement", "IsElement",
+})
+
+
+def slo_class(route: str) -> str:
+    """Coarse route family for SLO reporting: search | aggregate |
+    analytics | point | other. Distinct from core/admission.route_class
+    (priority classes for shedding) — this is the reporting taxonomy the
+    /slo body and dashboards group by."""
+    if route in _SEARCH_ROUTES:
+        return "search"
+    if route in _AGGREGATE_ROUTES:
+        return "aggregate"
+    if route in _ANALYTICS_ROUTES:
+        return "analytics"
+    if route in _POINT_ROUTES:
+        return "point"
+    return "other"
 
 
 @dataclass(frozen=True)
@@ -170,6 +201,7 @@ class SloEngine:
             out["routes"][route] = {
                 "objective": slo.objective,
                 "latency_ms": slo.latency_ms,
+                "class": slo_class(route),
                 "windows": wreport,
                 "budget_remaining": round(remaining, 6),
                 # page only when BOTH windows burn hot: the fast window

@@ -410,6 +410,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             analytics_max_request_bytes=cfg.analytics.max_request_bytes,
             admission=cfg.admission,
             resident=cfg.resident,
+            search=cfg.search,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),
@@ -555,6 +556,7 @@ def proxy_config(cfg: DDSConfig, supervisor, ssl_server, ssl_client,
         analytics_max_request_bytes=cfg.analytics.max_request_bytes,
         admission=cfg.admission,
         resident=cfg.resident,
+        search=cfg.search,
         ssl_server_context=ssl_server,
         ssl_client_context=ssl_client,
     )

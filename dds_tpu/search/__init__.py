@@ -1,0 +1,5 @@
+"""Spyglass: the device-resident encrypted search plane."""
+
+from dds_tpu.search.plane import GroupIndex, SearchPlane
+
+__all__ = ["GroupIndex", "SearchPlane"]

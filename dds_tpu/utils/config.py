@@ -317,6 +317,25 @@ class ResidentConfig:
 
 
 @dataclass
+class SearchConfig:
+    """Spyglass device-resident encrypted search plane (dds_tpu/search):
+    per-shard-group, per-column indexes over the DET (equality) and OPE
+    (order/range) column families, validated per query with ONE batched
+    tag round and evaluated with the ops/predicate kernels. Off = every
+    Search*/Order*/Range request takes the legacy full-keyspace scan.
+    DEPLOY.md "Encrypted search (Spyglass)" is the runbook."""
+
+    enabled: bool = False
+    # write-path ingest (the Lodestone pattern): committed writes queue
+    # their (tag, value) for index upsert OFF the request path, coalesced
+    # in ingest-window seconds; max-pending bounds the queue — overflowed
+    # keys simply read as stale at the next query and are repaired
+    write_ingest: bool = True
+    ingest_window: float = 0.005
+    max_pending: int = 8192
+
+
+@dataclass
 class AdmissionConfig:
     """Bulwark overload control (dds_tpu/core/admission): per-tenant/
     per-priority-class token buckets and SLO-burn-driven load shedding at
@@ -459,6 +478,7 @@ class DDSConfig:
     analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     resident: ResidentConfig = field(default_factory=ResidentConfig)
+    search: SearchConfig = field(default_factory=SearchConfig)
     fabric: FabricConfig = field(default_factory=FabricConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     debug: bool = False
@@ -512,6 +532,7 @@ _SUBSECTIONS = {
     ("DDSConfig", "analytics"): AnalyticsConfig,
     ("DDSConfig", "admission"): AdmissionConfig,
     ("DDSConfig", "resident"): ResidentConfig,
+    ("DDSConfig", "search"): SearchConfig,
     ("DDSConfig", "fabric"): FabricConfig,
     ("DDSConfig", "crypto"): CryptoConfig,
     ("ClientSettings", "data_table"): DataTableConfig,

@@ -34,6 +34,29 @@ class Plan:
         return jax.jit(lambda v: v * 2)
 
 
+_FN_CACHE_MAX = 64
+
+
+def _fn_cache_put(key, fn):
+    # ops/predicate's eviction discipline: FIFO-capped insert under the
+    # lock, tuple keys per op family (shapes retrace under one entry)
+    with _FN_LOCK:
+        while len(_FN_CACHE) >= _FN_CACHE_MAX:
+            _FN_CACHE.pop(next(iter(_FN_CACHE)), None)
+        _FN_CACHE[key] = fn
+
+
+def predicate_mask(op, values):
+    # ops/predicate's dispatch shape: tuple-keyed lookup, jit on miss,
+    # helper-mediated insert — the `*fn_cache*` helper IS the discipline
+    key = ("cmp", op)
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda v: v % 2 == 0 if op == "even" else v % 2 == 1)
+        _fn_cache_put(key, fn)
+    return fn(values)
+
+
 def hoisted_transfer(chunks):
     stacked = np.asarray(chunks)            # one transfer, outside the loop
     total = 0

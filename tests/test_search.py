@@ -1,0 +1,627 @@
+"""Spyglass encrypted-search tests (dds_tpu/search + ops/predicate).
+
+Covers the ISSUE 13 acceptance surface: predicate kernels bit-for-bit
+against host references (packed OPE lanes, digest candidates + confirm,
+stable sort permutations, host fallbacks for unpackable columns), every
+Search*/Order*/Range route answering identically through the indexed
+plane and the legacy scan (same server, same keys — ties included), S=4
+vs S=1 row-for-row, exactly ONE batched `abd.read_tags` round and zero
+per-key ABD reads per warm query, seeded-ChaosNet writes racing queries
+(stale entries detected via the tag round and repaired, zero Watchtower
+verdicts), the satellite regressions (Order* position validation and
+missing-column exclusion, SearchEntry* triplet parsing, empty-store
+consistency, pagination), the /health + /metrics surface, and the
+sentry `search latency` record contract.
+
+Values are synthetic ints/strings throughout: DET-style equality runs on
+plain strings via `DetKey.compare` (pure hmac), so nothing here needs
+the AES-backed schemes.
+"""
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from dds_tpu.http.miniserver import http_request
+from dds_tpu.http.server import DDSRestServer, ProxyConfig
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.obs.watchtower import watchtower
+from dds_tpu.search import GroupIndex, SearchPlane
+from dds_tpu.utils.config import SearchConfig
+from dds_tpu.utils.trace import tracer
+
+pytestmark = pytest.mark.search
+
+rng = random.Random(0x5EEC)
+
+
+def _metric(name, **labels):
+    return metrics.value(name, **labels) or 0
+
+
+def _violations() -> int:
+    return sum(watchtower.stats()["violations"].values())
+
+
+# ------------------------------------------------------------ kernel parity
+
+
+def test_group_index_kernels_match_host_reference():
+    """Every GroupIndex eval against a plain-Python reference over a
+    column with ties, and again over an unpackable column (negatives +
+    >2^52 ints) that must take the host fallback."""
+    idx = GroupIndex()
+    vals = [rng.randrange(0, 1 << 45) for _ in range(40)]
+    vals[7] = vals[3]  # ties exercise the stable sort
+    vals[21] = vals[3]
+    rows = {}
+    for i, v in enumerate(vals):
+        key = f"k{i:03d}"
+        rows[key] = [v, f"label{i % 5}", (-v if i % 3 else v << 12)]
+        idx.upsert(key, i + 1, rows[key])
+    pairs = sorted(rows.items())
+    thr = sorted(vals)[len(vals) // 2]
+
+    for pos in (0, 2):  # 0 = packed kernel path, 2 = host fallback
+        col = {k: v[pos] for k, v in pairs}
+        for op, ref in (("gt", lambda a, b: a > b), ("ge", lambda a, b: a >= b),
+                        ("lt", lambda a, b: a < b), ("le", lambda a, b: a <= b)):
+            t = thr if pos == 0 else -thr
+            assert idx.eval_compare(pos, op, t) == \
+                {k for k, v in col.items() if ref(v, t)}, (pos, op)
+        lo_b, hi_b = sorted(col.values())[10], sorted(col.values())[30]
+        assert idx.eval_range(pos, lo_b, hi_b) == \
+            {k for k, v in col.items() if lo_b <= v <= hi_b}
+        for desc in (False, True):
+            got = idx.eval_order(pos, desc)
+            want = sorted(col.items(), key=lambda t: t[1], reverse=desc)
+            assert [k for _, k in got] == [k for k, _ in want], (pos, desc)
+    # out-of-band thresholds resolve without touching the packed kernel
+    assert idx.eval_compare(0, "ge", -5) == {k for k, _ in pairs}
+    assert idx.eval_compare(0, "gt", 1 << 60) == set()
+    assert idx.eval_range(0, -(1 << 60), 1 << 60) == {k for k, _ in pairs}
+
+    assert idx.eval_eq(1, "label2", True) == \
+        {k for k, v in pairs if str(v[1]) == "label2"}
+    assert idx.eval_eq(1, "label2", False) == \
+        {k for k, v in pairs if str(v[1]) != "label2"}
+    assert idx.eval_entry(["label0", "nope", "label4"], "any") == \
+        {k for k, v in pairs
+         if any(str(e) in ("label0", "nope", "label4") for e in v)}
+    some_v = str(pairs[4][1][0])
+    assert idx.eval_entry([some_v, "label4"], "all") == \
+        {k for k, v in pairs
+         if all(any(str(e) == q for e in v) for q in (some_v, "label4"))}
+
+
+def test_group_index_tombstone_and_tag_discipline():
+    idx = GroupIndex()
+    idx.upsert("a", 3, [1, "x"])
+    idx.upsert("a", 2, [9, "old"])  # older tag must NOT win
+    assert idx.eval_compare(0, "ge", 0) == {"a"}
+    idx.upsert("a", 4, None)  # tombstone: validatable tag, no rows
+    assert idx.tag("a") == 4
+    assert idx.eval_compare(0, "ge", 0) == set()
+    assert idx.eval_eq(1, "x", True) == set()
+    idx.upsert("a", None, [5])  # tag-less writes are never indexed
+    assert idx.tag("a") == 4
+
+
+def test_search_plane_ingest_queue_and_invalidation():
+    plane = SearchPlane(max_pending=2)
+    plane.register_groups(["s0", "s1"])
+    assert plane.note_write("s0", "k1", 1, [5])
+    assert plane.note_write("s1", "k2", 1, [6])
+    assert not plane.note_write("s0", "k3", 1, [7])  # bounded: dropped
+    assert plane.stats()["dropped"] == 1
+    assert plane.ingest_pending() == 2
+    assert plane.group("s0").tag("k1") == 1
+    assert len(plane.group("s1")) == 1
+    plane.invalidate()
+    st = plane.stats()
+    assert st["indexed_keys"] == 0 and st["invalidations"] == 1
+    assert st["pending_ingest"] == 0
+    plane.export_gauges(metrics)
+    assert metrics.value("dds_search_invalidations") == 1
+
+
+# --------------------------------------------------------- REST route parity
+
+# pos 0: distinct packable ints (kernel compare/order/range); pos 1:
+# duplicated labels (DET eq + entry); pos 2: distinct negatives/huge ints
+# (host-fallback compare/order), absent on one row (exclusion semantics)
+ROWS = [
+    [100, "red", -3],
+    [250, "blue", 1 << 60],
+    [17, "green"],
+    [999, "blue", 0],
+    [42, "red", 7],
+    [500, "yellow", -40],
+    [77, "red", 12],
+    [360, "green", 5],
+]
+
+QUERIES = [
+    ("GET", "/OrderLS?position=0", None),
+    ("GET", "/OrderSL?position=0", None),
+    ("GET", "/OrderSL?position=2", None),
+    ("POST", "/SearchEq?position=1", {"value": "red"}),
+    ("POST", "/SearchNEq?position=1", {"value": "blue"}),
+    ("POST", "/SearchGt?position=0", {"value": 100}),
+    ("POST", "/SearchGtEq?position=0", {"value": 100}),
+    ("POST", "/SearchLt?position=0", {"value": 360}),
+    ("POST", "/SearchLtEq?position=0", {"value": 360}),
+    ("POST", "/SearchGt?position=2", {"value": 0}),
+    ("POST", "/Range?position=0", {"value1": 42, "value2": 500}),
+    ("POST", "/SearchEntry", {"value": "red"}),
+    ("POST", "/SearchEntryOR",
+     {"value1": "red", "value2": "17", "value3": "nope"}),
+    ("POST", "/SearchEntryAND",
+     {"value1": "red", "value2": "7", "value3": "42"}),
+]
+
+
+def _spy_server(S, enabled=True, net=None, write_ingest=True,
+                ingest_window=0.001):
+    from dds_tpu.core.transport import InMemoryNet
+    from dds_tpu.shard import build_constellation
+
+    net = net or InMemoryNet()
+    const = build_constellation(net, shard_count=S, vnodes_per_group=8,
+                                seed=3, n_active=4, n_sentinent=0, quorum=3)
+    cfg = ProxyConfig(
+        port=0, crypto_backend="cpu",
+        search=SearchConfig(enabled=enabled, write_ingest=write_ingest,
+                            ingest_window=ingest_window),
+    )
+    server = DDSRestServer(const.router, cfg)
+    return server, const
+
+
+async def _put_rows(server, rows):
+    key_to_row = {}
+    for i, row in enumerate(rows):
+        st, body = await http_request(
+            "127.0.0.1", server.cfg.port, "POST", "/PutSet",
+            json.dumps({"contents": row}).encode(), timeout=10.0,
+        )
+        assert st == 200
+        key_to_row[body.decode()] = i
+    return key_to_row
+
+
+async def _query(server, method, target, obj=None, expect=200):
+    body = json.dumps(obj).encode() if obj is not None else None
+    st, out = await http_request(
+        "127.0.0.1", server.cfg.port, method, target, body, timeout=30.0,
+    )
+    assert st == expect, (target, st, out[:200])
+    return json.loads(out)["keyset"] if st == 200 else None
+
+
+async def _both_paths(server, method, target, obj=None):
+    """(indexed, legacy) keysets for one request on the SAME server —
+    the legacy scan is forced by unplugging the plane, so both paths see
+    identical keys and the comparison is exact, ties included."""
+    indexed = await _query(server, method, target, obj)
+    plane, server._search = server._search, None
+    try:
+        legacy = await _query(server, method, target, obj)
+    finally:
+        server._search = plane
+    return indexed, legacy
+
+
+def test_indexed_routes_bit_for_bit_vs_legacy_and_across_shards():
+    """Acceptance (ISSUE 13): every search/order/range route answers
+    bit-for-bit the legacy scan's keyset on the same store (S=1 and
+    S=4), and S=4 equals S=1 row-for-row over identical contents."""
+
+    async def serve(S):
+        server, const = _spy_server(S)
+        await server.start()
+        try:
+            key_to_row = await _put_rows(server, ROWS)
+            if S > 1:  # scatter-gather really spans multiple groups
+                assert len(server._spy_partition(
+                    sorted(server.stored_keys))) > 1
+            out = []
+            for method, target, obj in QUERIES:
+                indexed, legacy = await _both_paths(server, method, target, obj)
+                assert indexed == legacy, (S, target)
+                out.append([key_to_row[k] for k in indexed])
+            # pagination parity rides the same store: slices of the full
+            # ordered keyset, identical across paths
+            full = await _query(server, "GET", "/OrderSL?position=0")
+            for q in ("offset=2", "limit=3", "offset=1&limit=2",
+                      "offset=50", "limit=0"):
+                got, leg = await _both_paths(
+                    server, "GET", f"/OrderSL?position=0&{q}")
+                assert got == leg, q
+                off = int(q.split("offset=")[1].split("&")[0]) \
+                    if "offset" in q else 0
+                lim = int(q.split("limit=")[1]) if "limit" in q else None
+                end = None if lim is None else off + lim
+                assert got == full[off:end], q
+            return out
+        finally:
+            await server.stop()
+            await const.stop()
+
+    async def go():
+        single = await serve(1)
+        sharded = await serve(4)
+        assert sharded == single  # row-for-row across shard counts
+
+    asyncio.run(go())
+
+
+def test_order_ties_stay_stable_across_paths():
+    """Tied order-column values: the device sort's tie order (ascending
+    key, via the stable complemented-lane sort and the heapq merge) must
+    equal the legacy stable sorted() exactly, ascending and descending."""
+    # all rows distinct (keys are content-derived) but pos-0 heavily tied
+    rows = [[5, i] for i in range(6)] + [[2, 9], [8, 1], [5, 77]]
+
+    async def go():
+        server, const = _spy_server(2)
+        await server.start()
+        try:
+            await _put_rows(server, rows)
+            for route in ("/OrderSL?position=0", "/OrderLS?position=0"):
+                indexed, legacy = await _both_paths(server, "GET", route)
+                assert indexed == legacy and len(indexed) == len(rows), route
+        finally:
+            await server.stop()
+            await const.stop()
+
+    asyncio.run(go())
+
+
+def test_warm_query_is_one_tag_round_and_one_kernel_dispatch():
+    """Acceptance (ISSUE 13): a warm indexed query spends exactly ONE
+    batched tag-validation round — a single `abd.read_tags` span at S=1,
+    one concurrent per-group span per non-empty shard group at S=4 (the
+    scatter side of the same single round) — ZERO per-key ABD value
+    reads, and dispatches the predicate kernel. Asserted via trace
+    spans."""
+
+    async def serve(S):
+        server, const = _spy_server(S)
+        await server.start()
+        try:
+            await _put_rows(server, ROWS)
+            # cold pass: misses repaired through full reads + re-ingest
+            await _query(server, "POST", "/SearchGtEq?position=0",
+                         {"value": 0})
+            groups = len(server._spy_partition(sorted(server.stored_keys)))
+            tracer.reset()
+            got = await _query(server, "POST", "/SearchGtEq?position=0",
+                               {"value": 0})
+            assert len(got) == len(ROWS)
+            spans = tracer.summary()
+            want_rounds = 1 if S == 1 else groups
+            assert spans.get("abd.read_tags", {}).get("count") \
+                == want_rounds, spans
+            assert "abd.fetch" not in spans, spans
+            assert spans.get("kernel.predicate.dispatch", {}).get("count", 0) \
+                >= 1, spans
+            assert spans.get("proxy.search_eval", {}).get("count") == 1
+        finally:
+            await server.stop()
+            await const.stop()
+
+    async def go():
+        await serve(1)
+        await serve(4)
+
+    asyncio.run(go())
+
+
+def test_chaosnet_racing_writes_detected_stale_and_repaired():
+    """Acceptance (ISSUE 13): under a seeded ChaosNet with delivery
+    delays, writes racing indexed queries (write-path ingest OFF, so the
+    index can only learn through the freshness protocol) are detected as
+    stale by the one tag round, repaired through full reads, and the
+    final results are bit-for-bit the legacy scan's — with zero
+    Watchtower verdicts."""
+    from dds_tpu.core.chaos import ChaosNet, LinkFaults
+    from dds_tpu.core.transport import InMemoryNet
+
+    async def go():
+        net = ChaosNet(InMemoryNet(), seed=909)
+        server, const = _spy_server(2, net=net, write_ingest=False)
+        await server.start()
+        v0 = _violations()
+        try:
+            for g in range(2):
+                for i in range(4):
+                    net.set_dest(f"s{g}-replica-{i}",
+                                 LinkFaults(delay=0.001, jitter=0.003))
+            key_to_row = await _put_rows(
+                server, [[(i + 1) * 10, f"c{i % 3}"] for i in range(6)]
+            )
+            keys = sorted(key_to_row, key=key_to_row.get)
+            await _query(server, "GET", "/OrderSL?position=0")  # warm
+
+            wrote = {}
+
+            async def writer():
+                w = random.Random(31)
+                for n in range(10):
+                    k = keys[w.randrange(len(keys))]
+                    val = 1000 + n
+                    st, _ = await http_request(
+                        "127.0.0.1", server.cfg.port, "PUT",
+                        f"/WriteElement/{k}?position=0",
+                        json.dumps({"value": val}).encode(), timeout=30.0,
+                    )
+                    assert st == 200
+                    wrote[k] = val
+                    await asyncio.sleep(0.002)
+
+            async def querier():
+                for _ in range(8):
+                    got = await _query(server, "POST",
+                                       "/SearchGtEq?position=0", {"value": 0})
+                    assert set(got) <= set(keys)  # sane mid-race snapshots
+                    await asyncio.sleep(0.003)
+
+            stale0 = _metric("dds_search_index_total", outcome="stale")
+            await asyncio.gather(writer(), querier())
+            # one deterministic post-race write: with write-path ingest
+            # off, the ONLY way the next query can see it is by the tag
+            # round flagging the key stale — so the stale counter must
+            # move even if the racing queries all lost their races
+            st, _ = await http_request(
+                "127.0.0.1", server.cfg.port, "PUT",
+                f"/WriteElement/{keys[0]}?position=0",
+                json.dumps({"value": 5000}).encode(), timeout=30.0,
+            )
+            assert st == 200
+            wrote[keys[0]] = 5000
+            # the post-race store: every overwrite must be visible to the
+            # indexed path (detected stale, repaired), bit-for-bit legacy
+            final = {k: wrote.get(k, (key_to_row[k] + 1) * 10) for k in keys}
+            indexed, legacy = await _both_paths(
+                server, "POST", "/SearchGt?position=0", {"value": 500})
+            assert indexed == legacy
+            assert set(indexed) == {k for k, v in final.items() if v > 500}
+            order, order_legacy = await _both_paths(
+                server, "GET", "/OrderLS?position=0")
+            assert order == order_legacy
+            assert order == [k for k, _ in sorted(
+                final.items(), key=lambda t: (-t[1], t[0]))]
+            assert _metric("dds_search_index_total", outcome="stale") \
+                > stale0  # the tag round really did catch racing writes
+            assert _violations() == v0  # zero Watchtower verdicts
+        finally:
+            await server.stop()
+            await const.stop()
+
+    asyncio.run(go())
+
+
+def test_removeset_tombstones_the_index_entry():
+    async def go():
+        server, const = _spy_server(2)
+        await server.start()
+        try:
+            key_to_row = await _put_rows(server, [[5, "a"], [9, "b"]])
+            gone = next(k for k, i in key_to_row.items() if i == 1)
+            await _query(server, "POST", "/SearchGtEq?position=0",
+                         {"value": 0})  # warm
+            st, _ = await http_request(
+                "127.0.0.1", server.cfg.port, "DELETE", f"/RemoveSet/{gone}",
+                timeout=10.0)
+            assert st == 200
+            indexed, legacy = await _both_paths(
+                server, "POST", "/SearchGtEq?position=0", {"value": 0})
+            assert indexed == legacy and gone not in indexed
+        finally:
+            await server.stop()
+            await const.stop()
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------- satellite b: Order* 400s
+
+
+def test_order_routes_validate_position_and_exclude_short_rows():
+    """Satellite (b): Order* no longer coerces missing columns to -inf —
+    short rows are EXCLUDED; non-integer columns and bad positions are a
+    400 on BOTH paths, per route."""
+    rows = [[5, 100], [3], [9, 50]]  # row [3] lacks position 1
+
+    async def go():
+        server, const = _spy_server(2)
+        await server.start()
+        try:
+            key_to_row = await _put_rows(server, rows)
+            short = next(k for k, i in key_to_row.items() if i == 1)
+            for route in ("OrderLS", "OrderSL"):
+                indexed, legacy = await _both_paths(
+                    server, "GET", f"/{route}?position=1")
+                assert indexed == legacy
+                assert short not in indexed and len(indexed) == 2, route
+                for path in ("indexed", "legacy"):
+                    plane = server._search
+                    if path == "legacy":
+                        server._search = None
+                    try:
+                        # non-numeric position / negative / missing: 400
+                        for q in ("position=zz", "position=-1", ""):
+                            await _query(server, "GET", f"/{route}?{q}",
+                                         expect=400)
+                    finally:
+                        server._search = plane
+            # a non-integer COLUMN is a 400 on both paths too (the int()
+            # contract every Search*/Order* route shares)
+            await _put_rows(server, [[7, "not-a-number"]])
+            for route in ("OrderLS", "OrderSL"):
+                i400, l400 = None, None
+                i400 = await _query(server, "GET", f"/{route}?position=1",
+                                    expect=400)
+                plane, server._search = server._search, None
+                try:
+                    l400 = await _query(server, "GET", f"/{route}?position=1",
+                                        expect=400)
+                finally:
+                    server._search = plane
+                assert i400 is None and l400 is None
+        finally:
+            await server.stop()
+            await const.stop()
+
+    asyncio.run(go())
+
+
+# --------------------------------------- satellite c: triplet edge + empties
+
+
+def test_entry_triplet_parsing_edge_cases():
+    """Satellite (c): SearchEntryOR/AND triplet parsing — non-triplet
+    bodies 400 on both paths; duplicate triplet values behave like the
+    single-query SearchEntry."""
+
+    async def go():
+        server, const = _spy_server(2)
+        await server.start()
+        try:
+            await _put_rows(server, ROWS)
+            bad_bodies = [
+                {"value1": "red", "value2": "blue"},   # missing value3
+                {"value": "red"},                      # item, not triplet
+                ["red", "blue", "green"],              # not a dict
+                {},
+            ]
+            for route in ("SearchEntryOR", "SearchEntryAND"):
+                for body in bad_bodies:
+                    await _query(server, "POST", f"/{route}", body,
+                                 expect=400)
+                    plane, server._search = server._search, None
+                    try:
+                        await _query(server, "POST", f"/{route}", body,
+                                     expect=400)
+                    finally:
+                        server._search = plane
+            # duplicated triplet values degenerate to the single query
+            dup = {"value1": "red", "value2": "red", "value3": "red"}
+            single = await _query(server, "POST", "/SearchEntry",
+                                  {"value": "red"})
+            for route in ("SearchEntryOR", "SearchEntryAND"):
+                got, legacy = await _both_paths(server, "POST", f"/{route}",
+                                                dup)
+                assert got == legacy == single, route
+        finally:
+            await server.stop()
+            await const.stop()
+
+    asyncio.run(go())
+
+
+def test_empty_store_answers_empty_keyset_on_every_route():
+    """Satellite (c): every search/order/range route on an EMPTY store is
+    200 {"keyset": []} — indexed and legacy alike."""
+
+    async def go():
+        server, const = _spy_server(2)
+        await server.start()
+        try:
+            for method, target, obj in QUERIES:
+                indexed, legacy = await _both_paths(server, method, target,
+                                                    obj)
+                assert indexed == legacy == [], target
+        finally:
+            await server.stop()
+            await const.stop()
+
+    asyncio.run(go())
+
+
+def test_pagination_params_validated():
+    async def go():
+        server, const = _spy_server(2)
+        await server.start()
+        try:
+            await _put_rows(server, ROWS)
+            for q in ("offset=-1", "limit=-2", "offset=zz", "limit=zz"):
+                await _query(server, "GET", f"/OrderSL?position=0&{q}",
+                             expect=400)
+                plane, server._search = server._search, None
+                try:
+                    await _query(server, "GET", f"/OrderSL?position=0&{q}",
+                                 expect=400)
+                finally:
+                    server._search = plane
+            # Range body contract: both bounds required, ints only
+            await _query(server, "POST", "/Range?position=0",
+                         {"value1": 3}, expect=400)
+            await _query(server, "POST", "/Range?position=0",
+                         {"value1": "x", "value2": 5}, expect=400)
+            # inverted bounds are a valid, empty selection
+            got, legacy = await _both_paths(
+                server, "POST", "/Range?position=0",
+                {"value1": 500, "value2": 42})
+            assert got == legacy == []
+        finally:
+            await server.stop()
+            await const.stop()
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------- surface + contract
+
+
+def test_health_metrics_and_slo_class_surface():
+    async def go():
+        server, const = _spy_server(2)
+        await server.start()
+        try:
+            await _put_rows(server, ROWS)
+            await _query(server, "POST", "/SearchEq?position=1",
+                         {"value": "red"})
+            st, body = await http_request("127.0.0.1", server.cfg.port,
+                                          "GET", "/health", timeout=10.0)
+            assert st == 200
+            health = json.loads(body)
+            assert health["search"]["indexed_keys"] == len(ROWS)
+            st, body = await http_request("127.0.0.1", server.cfg.port,
+                                          "GET", "/metrics", timeout=10.0)
+            text = body.decode()
+            assert 'dds_search_index_keys{shard="s' in text
+            assert "dds_search_pending_ingest" in text
+            assert 'dds_search_requests_total{' in text
+            st, body = await http_request("127.0.0.1", server.cfg.port,
+                                          "GET", "/slo", timeout=10.0)
+            slo = json.loads(body)["slo"]
+            assert slo["routes"]["SearchEq"]["class"] == "search"
+        finally:
+            await server.stop()
+            await const.stop()
+
+    asyncio.run(go())
+
+
+def test_sentry_search_record_contract(tmp_path):
+    from benchmarks.sentry import _check_search_records
+
+    bench = tmp_path / "benchmarks"
+    bench.mkdir()
+    good = {
+        "metric": "search latency (gt, N=96)", "value": 480.0,
+        "unit": "queries/s", "vs_baseline": 17.9,
+        "detail": {"op": "gt", "rows": 96, "hits": 48,
+                   "legacy_ms": 38.1, "indexed_ms": 2.1},
+    }
+    (bench / "results.json").write_text(json.dumps([good]))
+    assert _check_search_records(str(tmp_path)) == {"rows": 1}
+    bad = dict(good, detail={"op": "gt", "rows": 96, "hits": 48,
+                             "legacy_ms": 38.1})
+    (bench / "results.json").write_text(json.dumps([good, bad]))
+    with pytest.raises(ValueError, match="malformed search-latency record"):
+        _check_search_records(str(tmp_path))
