@@ -1,0 +1,146 @@
+"""Panopticon shipper overhead on a real multi-process Meridian fleet.
+
+    python -m benchmarks.fleet_obs_overhead [--rate 80] [--duration 2]
+
+Spawns the benchmarks/multihost_load loopback fleet TWICE — shipper off
+(plain PR-8 fleet) and shipper on ([obs.fleet] enabled in every group
+process, the collector + Watchtower armed in the proxy) — and drives both
+with the same coordinated-omission-safe open-loop load. The record the
+run exists for: telemetry is supposed to be free-ish (spool + batch off
+the request path), so `overhead_pct` — the goodput cost of turning the
+whole fleet-observability plane on — is the number CI watches, alongside
+the collector's own census (sources seen, trees stitched, drops
+accounted) scraped from `GET /fleet/metrics` to prove the plane was
+actually live during the measurement, not just configured.
+
+One `fleet obs` record lands via `benchmarks.common.emit`;
+`sentry.py --check` validates its shape (exit 2 on malformed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.multihost_load import Fleet  # noqa: E402
+
+
+def _fleet_stanzas(collector: str) -> tuple[str, str]:
+    """(group_extra, proxy_extra) TOML arming the Panopticon plane."""
+    group = f"""
+[obs.fleet]
+enabled = true
+collector = "{collector}"
+flush-interval = 0.1
+"""
+    proxy = """
+[obs.fleet]
+enabled = true
+stitch-window = 0.5
+"""
+    return group, proxy
+
+
+async def _measure(fleet: Fleet, rate: float, duration: float, keys: int,
+                   zipf_s: float, seed: int):
+    from dds_tpu.fabric.loadgen import OpenLoopLoad
+
+    load = OpenLoopLoad(fleet.proxy_targets, keys=keys, zipf_s=zipf_s,
+                        seed=seed, timeout=5.0)
+    await load.seed()
+    return await load.run(rate, duration)
+
+
+async def _fleet_census(port: int) -> dict:
+    """Scrape the collector's /fleet/metrics for proof-of-life numbers."""
+    from dds_tpu.http.miniserver import http_request
+    from dds_tpu.obs.panopticon import parse_samples
+
+    status, body = await http_request(
+        "127.0.0.1", port, "GET", "/fleet/metrics", timeout=5.0)
+    if status != 200:
+        raise RuntimeError(f"GET /fleet/metrics -> {status}")
+    text = body.decode() if isinstance(body, (bytes, bytearray)) else str(body)
+    sources = parse_samples(text, "dds_fleet_sources")
+    stitched = parse_samples(text, "dds_fleet_traces_stitched_total")
+    dropped = parse_samples(text, "dds_fleet_ship_dropped_by_source")
+    return {
+        "sources": int(sources[0][1]) if sources else 0,
+        "stitched": int(sum(v for _, v in stitched)),
+        "dropped": int(sum(v for _, v in dropped)),
+    }
+
+
+def _run_one(shipper_on: bool, rate: float, duration: float, keys: int,
+             zipf_s: float, seed: int):
+    with tempfile.TemporaryDirectory(prefix="fleet-obs-") as workdir:
+        fleet = Fleet(workdir)
+        if shipper_on:
+            # ports exist after __init__; arm the stanzas before start()
+            # writes the configs — the groups ship at the proxy's TcpNet
+            fleet.group_extra, fleet.proxy_extra = _fleet_stanzas(
+                fleet.proxy_transport)
+        census = {}
+        try:
+            fleet.start()
+            asyncio.run(fleet.wait_healthy())
+            report = asyncio.run(
+                _measure(fleet, rate, duration, keys, zipf_s, seed))
+            if shipper_on:
+                # settle one stitch window so shipped trees land, then
+                # prove the plane was live during the run
+                asyncio.run(asyncio.sleep(1.0))
+                census = asyncio.run(
+                    _fleet_census(fleet.ports["proxy"][0]))
+        finally:
+            fleet.stop()
+        return report, census, len(fleet.gids) + len(fleet.ports["proxy"])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rate", type=float, default=80.0,
+                    help="open-loop arrival rate (req/s)")
+    ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--keys", type=int, default=32)
+    ap.add_argument("--zipf", type=float, default=1.1)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+
+    from benchmarks.common import emit
+
+    off, _, _ = _run_one(False, args.rate, args.duration, args.keys,
+                         args.zipf, args.seed)
+    on, census, procs = _run_one(True, args.rate, args.duration, args.keys,
+                                 args.zipf, args.seed)
+
+    off_good = max(1, off.good)
+    overhead = 1.0 - (on.good / off_good)
+    return [emit(
+        "fleet obs",
+        on.good / max(args.duration, 1e-9),
+        "req/s",
+        on.good / off_good,
+        rate=args.rate,
+        duration=args.duration,
+        processes=procs,
+        open_loop=True,
+        on_good=on.good,
+        off_good=off.good,
+        overhead_pct=round(overhead * 100.0, 2),
+        on_p95_ms=round(on.p95_ms, 3),
+        off_p95_ms=round(off.p95_ms, 3),
+        sources=census.get("sources", 0),
+        stitched=census.get("stitched", 0),
+        dropped=census.get("dropped", 0),
+    )]
+
+
+if __name__ == "__main__":
+    main()

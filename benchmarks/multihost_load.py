@@ -43,7 +43,8 @@ def free_port() -> int:
 
 
 def _toml(role: str, t_port: int, ports: dict, *, proxy_port: int = 0,
-          status_port: int = 0, keys: int = 0) -> str:
+          status_port: int = 0, keys: int = 0, audit: bool = False,
+          extra: str = "") -> str:
     groups = "\n".join(
         f'{gid} = "127.0.0.1:{p}"' for gid, p in sorted(ports["groups"].items())
     )
@@ -76,7 +77,7 @@ port = {proxy_port}
 nr-of-operations = {keys}
 
 [obs]
-audit-enabled = false
+audit-enabled = {str(audit).lower()}
 
 [fabric]
 role = "{role}"
@@ -87,6 +88,7 @@ admin-routes = true
 
 [fabric.groups]
 {groups}
+{extra}
 """
 
 
@@ -96,29 +98,56 @@ class Fleet:
     multihost test, which adds a standby group and drives a live split."""
 
     def __init__(self, workdir: str, *, standby: int = 0,
-                 proxy_count: int = 1):
+                 proxy_count: int = 1, group_extra="",
+                 proxy_extra: str = "", proxy_audit: bool = False):
         self.dir = pathlib.Path(workdir)
         gids = ["s0", "s1"] + [f"s{2 + i}" for i in range(standby)]
         self.ports = {
             "groups": {gid: free_port() for gid in gids},
             "status": [free_port() for _ in gids],
             "proxy": [free_port() for _ in range(proxy_count)],
+            # proxy TRANSPORT ports are allocated up front (not at config-
+            # write time) so group-process stanzas can reference them —
+            # e.g. [obs.fleet] collector = the proxy's TcpNet bind
+            "proxy_t": [free_port() for _ in range(proxy_count)],
         }
         self.gids = gids
+        # extra TOML appended per role config; must start with a section
+        # header (it lands after [fabric.groups]). group_extra may be a
+        # dict gid -> stanza so one group can be armed differently (the
+        # cross-host audit regression forges stale tags in s0 only)
+        self.group_extra = group_extra
+        self.proxy_extra = proxy_extra
+        # proxy-side Watchtower audits ([obs] audit-enabled): the collector
+        # feeds it stitched cross-host traces when [obs.fleet] is on too
+        self.proxy_audit = proxy_audit
         self.procs: dict[str, subprocess.Popen] = {}
 
     def config_path(self, name: str) -> pathlib.Path:
         return self.dir / f"{name}.toml"
+
+    @property
+    def proxy_transport(self) -> str:
+        """host:port of proxy0's TcpNet — the Panopticon collector addr."""
+        return f"127.0.0.1:{self.ports['proxy_t'][0]}"
+
+    def _group_extra(self, gid: str) -> str:
+        if isinstance(self.group_extra, dict):
+            return self.group_extra.get(gid, "")
+        return self.group_extra
 
     def _write_configs(self) -> None:
         for i, gid in enumerate(self.gids):
             self.config_path(gid).write_text(_toml(
                 f"group:{gid[1:]}", self.ports["groups"][gid], self.ports,
                 status_port=self.ports["status"][i],
+                extra=self._group_extra(gid),
             ))
         for i, port in enumerate(self.ports["proxy"]):
             self.config_path(f"proxy{i}").write_text(_toml(
-                "proxy", free_port(), self.ports, proxy_port=port,
+                "proxy", self.ports["proxy_t"][i], self.ports,
+                proxy_port=port, audit=self.proxy_audit,
+                extra=self.proxy_extra,
             ))
 
     def spawn(self, name: str) -> subprocess.Popen:

@@ -19,10 +19,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from benchmarks import (analytics_matvec, audit_cost, bft_sum, crossover,
-                            decrypt_throughput, encrypt_modexp, mixed,
-                            multihost_load, overload_goodput, product,
-                            put_concurrency, resident_fold, search_latency,
-                            shard_scaling, sweep)
+                            decrypt_throughput, encrypt_modexp,
+                            fleet_obs_overhead, mixed, multihost_load,
+                            overload_goodput, product, put_concurrency,
+                            resident_fold, search_latency, shard_scaling,
+                            sweep)
 
     rows = []
     if args.quick:
@@ -42,6 +43,9 @@ def main(argv=None):
         )
         rows += multihost_load.main(
             ["--rates", "40,100", "--duration", "1.5", "--keys", "24"]
+        )
+        rows += fleet_obs_overhead.main(
+            ["--rate", "40", "--duration", "1.5", "--keys", "24"]
         )
         rows += resident_fold.main(
             ["--k", "64", "--shards", "1,2", "--bits", "256",
@@ -64,6 +68,7 @@ def main(argv=None):
         rows += analytics_matvec.main([])
         rows += overload_goodput.main([])
         rows += multihost_load.main([])
+        rows += fleet_obs_overhead.main([])
         rows += resident_fold.main([])
         rows += decrypt_throughput.main([])
         rows += search_latency.main([])

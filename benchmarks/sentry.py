@@ -11,11 +11,12 @@ Modes (all emit one JSON line to stdout):
         `overload goodput` (benchmarks/overload_goodput.py),
         `multihost load` (benchmarks/multihost_load.py),
         `resident fold` (benchmarks/resident_fold.py),
+        `fleet obs` (benchmarks/fleet_obs_overhead.py),
         `decrypt throughput` (benchmarks/decrypt_throughput.py) and
         `search latency` (benchmarks/search_latency.py) records
         in benchmarks/results.json / results_quick.json so a malformed
-        scaling, analytics, overload, multihost, resident, decrypt or
-        search record is caught by the same smoke.
+        scaling, analytics, overload, multihost, fleet-obs, resident,
+        decrypt or search record is caught by the same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -286,6 +287,48 @@ def _check_multihost_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_fleet_obs_records(root: str = REPO) -> dict:
+    """Validate `fleet obs` rows (benchmarks/fleet_obs_overhead.py):
+    positive good-req/s value and a detail block carrying the shipper-
+    on/off goodput pair, the overhead percentage (any sign — noise can
+    make the shipper run faster), an OS-process count >= 2, the open-loop
+    flag, and the collector's proof-of-life census: sources >= 1 (the
+    groups actually shipped), non-negative stitched/dropped counts (drops
+    ACCOUNTED is the contract, zero drops is not). Same malformed
+    contract as the other row families: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("fleet obs")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("on_good"), int)
+            and detail["on_good"] >= 1
+            and isinstance(detail.get("off_good"), int)
+            and detail["off_good"] >= 1
+            and isinstance(detail.get("overhead_pct"), (int, float))
+            and isinstance(detail.get("processes"), int)
+            and detail["processes"] >= 2
+            and detail.get("open_loop") is True
+            and isinstance(detail.get("sources"), int)
+            and detail["sources"] >= 1
+            and isinstance(detail.get("stitched"), int)
+            and detail["stitched"] >= 0
+            and isinstance(detail.get("dropped"), int)
+            and detail["dropped"] >= 0
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed fleet-obs record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _check_decrypt_records(root: str = REPO) -> dict:
     """Validate `decrypt throughput` rows (benchmarks/decrypt_throughput
     .py): positive ops/s value and a detail block naming the key size,
@@ -364,6 +407,7 @@ def main(argv=None) -> int:
             analytics = _check_analytics_records()
             overload = _check_overload_records()
             multihost = _check_multihost_records()
+            fleet_obs = _check_fleet_obs_records()
             resident = _check_resident_records()
             decrypt = _check_decrypt_records()
             search = _check_search_records()
@@ -378,6 +422,7 @@ def main(argv=None) -> int:
             "analytics_rows": analytics["rows"],
             "overload_rows": overload["rows"],
             "multihost_rows": multihost["rows"],
+            "fleet_obs_rows": fleet_obs["rows"],
             "resident_rows": resident["rows"],
             "decrypt_rows": decrypt["rows"],
             "search_rows": search["rows"],

@@ -479,6 +479,48 @@ class ShardPruned:
 
 
 # --------------------------------------------------------------------------
+# Panopticon fleet telemetry (dds_tpu/obs/panopticon)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TelemetryBatch:
+    """Shipper -> collector: one batch of fleet telemetry from a non-proxy
+    process. `spans` is a list of completed span trees (each a list of
+    `utils.trace.event_dict` dicts), `incidents` flight-recorder index
+    entries, `metrics_text` the source's full Prometheus exposition, and
+    `slo` its SloEngine report. `mac` is HMAC-SHA256 over the canonical
+    JSON of the payload with the fleet telemetry secret — an extra
+    integrity layer above the frame MAC, so a collector can accept
+    batches relayed through untrusted hops. Integrity only: a Byzantine
+    HOST can still sign lies about its own stats (DEPLOY.md "Fleet
+    observability"). The list/dict fields ride opaque on purpose — span
+    meta is workload-derived and must never decode as protocol objects."""
+
+    host: str
+    role: str
+    shard: str
+    seq: int
+    ts: float
+    spans: list
+    incidents: list
+    metrics_text: str
+    slo: dict
+    dropped: int          # spool drops at the SOURCE since process start
+    mac: bytes
+
+
+@dataclass(frozen=True)
+class TelemetryAck:
+    """Collector -> shipper: batch `seq` landed (ok=False = bad MAC or
+    malformed — the shipper counts rejects but never retries a reject:
+    a batch the collector refuses once will be refused again)."""
+
+    seq: int
+    ok: bool
+    error: str = ""
+
+
+# --------------------------------------------------------------------------
 # fault injection backdoor (malicious/MaliciousAttack.scala:34)
 # --------------------------------------------------------------------------
 
@@ -515,6 +557,7 @@ _TYPES = {
         WrongShard, ShardMigrateBegin, ShardMigrateAck,
         ShardMapInstall, ShardMapActivate, ShardMapAck,
         ShardExportRequest, ShardExport, ShardPruneRequest, ShardPruned,
+        TelemetryBatch, TelemetryAck,
     )
 }
 

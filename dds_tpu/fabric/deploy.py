@@ -277,6 +277,55 @@ def _namer(net):
     return fn
 
 
+def _fleet_secret(cfg) -> bytes:
+    """The telemetry-batch HMAC key: its own secret when configured, else
+    derived from the intranet secret every fleet process already shares."""
+    return (cfg.obs.fleet.secret or cfg.security.abd_mac_secret).encode()
+
+
+def _identify(cfg, namer, role: str, shard: str = "") -> dict:
+    """Stamp this process's identity everywhere satellite views read it:
+    the `dds_process_info` gauge on /metrics and the flight recorder's
+    incident headers/index (fleet-wide correlation attributes by these)."""
+    from dds_tpu.obs.flight import flight
+    from dds_tpu.obs.panopticon import process_info
+
+    host = namer("_id").rsplit("/", 1)[0]
+    identity = {"host": host, "role": role}
+    if shard:
+        identity["shard"] = shard
+    flight.configure(identity=identity)
+    process_info(role=role, shard=shard)
+    return identity
+
+
+def _start_shipper(cfg, net, namer, stoppables, *, role: str,
+                   shard: str = "", slo=None):
+    """Wire this process's span shipper at the fleet's collector (no-op
+    unless [obs.fleet] is enabled AND names one)."""
+    fl = cfg.obs.fleet
+    if not (fl.enabled and fl.collector):
+        return None
+    from dds_tpu.obs.panopticon import SpanShipper
+
+    shipper = SpanShipper(
+        net,
+        collector=fl.collector,
+        secret=_fleet_secret(cfg),
+        host=namer("_id").rsplit("/", 1)[0],
+        role=role,
+        shard=shard,
+        spool_max=fl.spool_max,
+        batch_max=fl.batch_max,
+        flush_interval=fl.flush_interval,
+        flight_dir=cfg.obs.flight_dir,
+        slo=slo,
+    )
+    shipper.start()
+    stoppables.append(_Stopper(shipper.stop))
+    return shipper
+
+
 def _attach_watchtower(cfg, *, check_quorum: bool, geometry: dict) -> None:
     if not cfg.obs.audit_enabled:
         return
@@ -379,10 +428,11 @@ async def _launch_all(cfg, net, stoppables, ssl_server, ssl_client):
     )
     await server.start()
 
+    _identify(cfg, namer, "all")
     dep = Deployment(cfg, net, replicas, None, server,
                      const.groups[0].trudy, ssl_client, stoppables,
                      constellation=const)
-    # every replica's handler spans land in THIS process's tracer ring, so
+    # every replica's spans land in THIS process's tracer ring, so
     # the quorum-intersection audit stays sound even over sockets
     _attach_watchtower(
         cfg, check_quorum=True,
@@ -443,6 +493,14 @@ async def _launch_group(cfg, net, stoppables, ssl_server, ssl_client,
             node.antientropy.start()
     stoppables.append(_Stopper(group.stop))
 
+    if cfg.attacks.enabled and cfg.attacks.type == "stale_tag":
+        # the cross-host audit regression schedule: this group's replicas
+        # answer reads with properly-MAC'd forged stale tags — only the
+        # collector-fed Watchtower on the proxy can catch it
+        from dds_tpu.malicious.trudy import arm_stale_tag_forgers
+
+        arm_stale_tag_forgers(group.replicas)
+
     hub = EpochGossipHub()
     view = RemoteShardManager(smap, secret, hub=hub)
     agent = MeridianAgent(net, namer(f"{gid}-fabric"), group, view, secret,
@@ -464,6 +522,10 @@ async def _launch_group(cfg, net, stoppables, ssl_server, ssl_client,
         group=group, gid=gid, ssl_context=ssl_server,
     )
     await server.start()
+
+    _identify(cfg, namer, f"group:{gid}", shard=gid)
+    _start_shipper(cfg, net, namer, stoppables, role=f"group:{gid}",
+                   shard=gid)
 
     dep = Deployment(cfg, net, dict(group.replicas), None, server,
                      group.trudy, ssl_client, stoppables)
@@ -501,6 +563,30 @@ async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
         state_flag = (body or {}).get("state")
 
     hub = EpochGossipHub()
+    slo_engine = SloEngine.from_obs(cfg.obs)
+
+    # Panopticon: the fleet collector lives with the proxy role — shipped
+    # group-process spans stitch onto this process's proxy spans, and the
+    # federated /fleet/* views serve from here
+    collector = None
+    if cfg.obs.fleet.enabled:
+        from dds_tpu.obs.panopticon import FleetCollector, NullWatchtower
+
+        collector = FleetCollector(
+            net,
+            secret=_fleet_secret(cfg),
+            host=namer("_id").rsplit("/", 1)[0],
+            role="proxy",
+            stitch_window=cfg.obs.fleet.stitch_window,
+            staleness=cfg.obs.fleet.staleness,
+            slo=slo_engine,
+            # audits off -> stitched traces are sunk, not judged against
+            # an unconfigured geometry
+            watchtower=None if cfg.obs.audit_enabled else NullWatchtower(),
+        )
+
+    def _audit_geometry(m: ShardMap) -> dict:
+        return {g: (sh.quorum_size, sh.replicas_per_group) for g in m.groups}
 
     def make_client(cgid: str) -> AbdClient:
         active, _ = group_endpoints(cfg, cgid)
@@ -524,6 +610,11 @@ async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
             c.shard_epoch = lambda m=manager: m.current().epoch
             router.clients[new_gid] = c
             log.info("grew a client for new group %s", new_gid)
+        if collector is not None and cfg.obs.audit_enabled:
+            # a split-born group must audit against ITS geometry too
+            from dds_tpu.obs.watchtower import watchtower
+
+            watchtower.configure(group_geometry=_audit_geometry(new_map))
 
     manager = RemoteShardManager(smap, secret, hub=hub, on_install=on_install)
     if state_flag:
@@ -554,19 +645,39 @@ async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
             reshard_route_enabled=fab.admin_routes,
         ),
         local_replicas={},
-        slo=SloEngine.from_obs(cfg.obs),
+        slo=slo_engine,
         gossip=hub,
         reshard=controller.split,
+        fleet=collector,
     )
     await server.start()
 
+    _identify(cfg, namer, "proxy")
     dep = Deployment(cfg, net, {}, None, server, None, ssl_client,
                      stoppables)
-    # no replica handler spans in this process: tag/repair/state-machine
-    # audits stay on, quorum-intersection ones can't be sound here
-    _attach_watchtower(
-        cfg, check_quorum=False,
-        geometry={g: (sh.quorum_size, sh.replicas_per_group)
-                  for g in smap.groups},
-    )
+    if collector is not None:
+        collector.start()
+        stoppables.append(_Stopper(collector.stop))
+        if cfg.obs.audit_enabled:
+            # the collector replays STITCHED trace trees — local proxy
+            # spans plus the shipped remote replica-handler spans — so
+            # the quorum-intersection audits are sound here again. The
+            # Watchtower is fed exclusively through the collector (no
+            # direct tracer attach: each trace must be audited once,
+            # complete).
+            from dds_tpu.obs.watchtower import watchtower
+
+            watchtower.configure(
+                quorum_size=sh.quorum_size,
+                n_replicas=sh.replicas_per_group,
+                check_quorum=cfg.obs.audit_quorum_checks,
+                group_geometry=_audit_geometry(smap),
+            )
+    else:
+        # no replica handler spans in this process: tag/repair/state-
+        # machine audits stay on, quorum-intersection ones can't be sound
+        _attach_watchtower(
+            cfg, check_quorum=False,
+            geometry=_audit_geometry(smap),
+        )
     return dep

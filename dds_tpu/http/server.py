@@ -92,7 +92,7 @@ _RETRYABLE = (ByzantineError, WrongShardError, asyncio.TimeoutError,
 # reshard control route) bypass the Bulwark gate entirely and keep
 # answering through a full shed.
 _ADMISSION_EXEMPT = frozenset({"health", "metrics", "slo", "shards",
-                               "_trace", "_reshard"})
+                               "fleet", "_trace", "_reshard"})
 
 
 @dataclass
@@ -242,15 +242,18 @@ class DDSRestServer:
     def __init__(self, abd: AbdClient, config: ProxyConfig | None = None,
                  local_replicas: dict | None = None,
                  slo: SloEngine | None = None,
-                 gossip=None, reshard=None):
+                 gossip=None, reshard=None, fleet=None):
         self.abd = abd
         self.cfg = config or ProxyConfig()
         # Meridian wiring: `gossip` is an EpochGossipHub parked /shards
         # long-polls sleep on (None = conditional GETs answer immediately);
         # `reshard` is the fabric controller's async split(source, target)
-        # hook behind POST /_reshard (gated by reshard_route_enabled)
+        # hook behind POST /_reshard (gated by reshard_route_enabled);
+        # `fleet` is the Panopticon FleetCollector serving GET /fleet/*
+        # (None everywhere but a fleet-enabled proxy role — the routes 404)
         self._gossip = gossip
         self._reshard = reshard
+        self._fleet = fleet
         # per-route SLO accounting (obs/slo): every request is classified
         # good/bad in handle(); run.launch passes an engine built from the
         # [obs] config, tests get the defaults
@@ -1572,6 +1575,36 @@ class DDSRestServer:
                 if self.admission is not None:
                     body["admission"] = self.admission.report()
                 return Response.json(body)
+
+            case ("GET", "fleet") if self._fleet is not None and arg:
+                # Panopticon federation (obs/panopticon): every fleet
+                # process's telemetry, served from the proxy's collector.
+                # Admission-exempt like /metrics — the fleet views must
+                # answer WHILE the fleet sheds.
+                if arg == "metrics":
+                    # relabeled merge of every source's exposition, each
+                    # sample tagged host/role/shard, staleness-marked per
+                    # source (dds_fleet_source_age_seconds/_stale)
+                    self._sample_state_gauges()
+                    self._fleet.sample_gauges()
+                    return Response(
+                        200,
+                        self._fleet.fleet_metrics().encode(),
+                        content_type=(
+                            "text/plain; version=0.0.4; charset=utf-8"
+                        ),
+                    )
+                if arg == "slo":
+                    # per-host reports + fleet rollup: worst-of and
+                    # sum-of burn per route/window, resident-pool
+                    # pressure per group, shed level per host
+                    return Response.json(self._fleet.fleet_slo())
+                if arg == "incidents":
+                    # fleet-wide flight incidents correlated by trace id,
+                    # plus the collector-fed Watchtower's verdicts
+                    tid = req.query.get("trace_id") or None
+                    return Response.json(self._fleet.fleet_incidents(tid))
+                return Response(404)
 
             case ("GET", "_trace") if self.cfg.trace_route_enabled:
                 # live observability (SURVEY §5.5): per-span timing summary

@@ -9,6 +9,9 @@
 - `obs.watchtower` — online BFT invariant auditor over completed traces.
 - `obs.slo` — per-route latency objectives + error-budget burn tracking.
 - `obs.sentry` — per-kernel timing baselines + regression comparison.
+- `obs.panopticon` — fleet-wide plane: cross-host span shipping, the
+  proxy-side collector (stitch + Watchtower replay), federated
+  metrics/SLO, and incident correlation.
 
 `flight` and `kprof` import `utils/trace`, which imports `obs.context` —
 so this package eagerly exposes only the leaf modules and lazily resolves
@@ -20,12 +23,13 @@ from dds_tpu.obs.metrics import Registry, metrics  # noqa: F401
 
 __all__ = [
     "context", "metrics", "Registry", "flight", "kprof",
-    "watchtower", "slo", "sentry",
+    "watchtower", "slo", "sentry", "panopticon",
 ]
 
 
 def __getattr__(name):
-    if name in ("flight", "kprof", "watchtower", "slo", "sentry"):
+    if name in ("flight", "kprof", "watchtower", "slo", "sentry",
+                "panopticon"):
         import importlib
 
         return importlib.import_module(f"{__name__}.{name}")

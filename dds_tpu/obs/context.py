@@ -89,15 +89,40 @@ def to_wire(ctx: Optional[SpanContext] = None) -> Optional[dict]:
     return {"t": ctx.trace_id, "s": ctx.span_id}
 
 
+def _valid_id(v) -> bool:
+    """Ids we mint are 16 lowercase-hex chars; accept up to 32 (the W3C
+    traceparent width) so foreign tracers can interop, but ONLY hex — the
+    `tc` field is unauthenticated, and these strings end up as collector
+    dict keys, metric labels, and flight-incident headers."""
+    return (isinstance(v, str) and 0 < len(v) <= 32
+            and all(c in "0123456789abcdef" for c in v))
+
+
 def from_wire(d) -> Optional[SpanContext]:
     """Parse a frame's `tc` field; garbage (or absence) degrades to None —
-    a malformed trace context must never drop the message it rode on."""
+    a malformed trace context must never drop the message it rode on.
+    Strict length/charset clamp: a hostile peer's oversized or non-hex
+    ids are refused wholesale (the span orphans into a fresh local root)
+    instead of truncated into a colliding-but-plausible id that would
+    poison cross-host stitching."""
+    if d is None:
+        return None
     if not isinstance(d, dict):
-        return None
+        return _malformed()
     t, s = d.get("t"), d.get("s")
-    if not isinstance(t, str) or not isinstance(s, str) or not t or not s:
-        return None
-    return SpanContext(t[:32], s[:32])
+    if not _valid_id(t) or not _valid_id(s):
+        return _malformed()
+    return SpanContext(t, s)
+
+
+def _malformed() -> None:
+    """Present garbage (vs. absent context): count it so a peer spraying
+    hostile `tc` fields is visible on /metrics."""
+    from dds_tpu.obs.metrics import metrics  # lazy: avoid import cycle
+
+    metrics.inc("dds_trace_context_malformed_total",
+                help="hostile/garbled tc frame fields dropped at ingest")
+    return None
 
 
 # ----------------------------------------------------------------- header

@@ -66,9 +66,14 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._last: dict[str, float] = {}  # kind -> monotonic ts of last dump
         self._seq = 0
+        # process identity stamped into every incident header (host/role/
+        # shard) so fleet-wide correlation (obs/panopticon) can attribute
+        # an incident to its source without parsing file paths
+        self.identity: dict = {}
 
     def configure(self, dir: str | None = None, max_incidents: int | None = None,
-                  min_interval: float | None = None) -> None:
+                  min_interval: float | None = None,
+                  identity: dict | None = None) -> None:
         """Late wiring from a deployment config (run.launch)."""
         if dir is not None:
             self.dir = dir or None
@@ -76,6 +81,8 @@ class FlightRecorder:
             self.max_incidents = max_incidents
         if min_interval is not None:
             self.min_interval = min_interval
+        if identity is not None:
+            self.identity = {k: str(v) for k, v in identity.items()}
 
     @property
     def enabled(self) -> bool:
@@ -135,6 +142,7 @@ class FlightRecorder:
             "incident": kind,
             "ts": time.time(),
             "trace_id": trace_id,
+            **self.identity,
             "info": info,
             "counters": tracer.counters(),
             "summary": tracer.summary(),
@@ -160,7 +168,7 @@ class FlightRecorder:
         os.replace(tmp, path)
         self._index_append(d, {
             "ts": header["ts"], "kind": kind, "trace_id": trace_id,
-            "path": name,
+            "path": name, **self.identity,
         })
         metrics.inc("dds_incidents_total", kind=kind,
                     help="flight-recorder incident dumps written")

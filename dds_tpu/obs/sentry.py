@@ -16,8 +16,10 @@ Baseline file schema (JSON):
             "dispatch": {"p50_ms": ..., "p95_ms": ..., "count": N},
             "execute":  {"p50_ms": ..., "p95_ms": ..., "count": N}}}}
 
-Kernels are keyed by name plus the shape-ish span meta (`k`, `R`, `P2`)
-so a baseline taken at one fold width is never compared against another.
+Kernels are keyed by execution platform (`platform()`, e.g. `cpu::` /
+`tpu::`) plus name plus the shape-ish span meta (`k`, `R`, `P2`) so a
+baseline taken at one fold width — or on one accelerator — is never
+compared against another.
 `benchmarks/common.emit()` persists new kernels opportunistically on
 every benchmark run (existing entries are kept unless
 `DDS_KERNEL_BASELINE_UPDATE` is truthy), so the baseline grows with the
@@ -34,7 +36,7 @@ import time
 
 __all__ = [
     "collect", "load_baseline", "save_baseline", "compare",
-    "baseline_path", "persist_from_tracer",
+    "baseline_path", "persist_from_tracer", "platform",
 ]
 
 PHASES = ("dispatch", "execute")
@@ -58,6 +60,26 @@ def baseline_path(path: str | None = None) -> pathlib.Path:
     return repo / "benchmarks" / _DEFAULT_BASENAME
 
 
+def platform() -> str:
+    """The execution-platform namespace prefixed onto every baseline key
+    (`cpu::foldmany[...]`, `tpu::foldmany[...]`): a shared baseline file
+    can hold rows from several environments without a CPU-fabric run ever
+    comparing — or, with DDS_KERNEL_BASELINE_UPDATE, ratcheting — against
+    an on-chip row. DDS_SENTRY_PLATFORM overrides; otherwise the jax
+    default backend of the process that RAN the kernels (collect() is the
+    only caller, and kernel spans imply jax was importable). `--check`
+    never calls this, keeping the CI smoke jax-free."""
+    env = os.environ.get("DDS_SENTRY_PLATFORM", "").strip()
+    if env:
+        return env
+    try:
+        import jax
+
+        return str(jax.default_backend())
+    except Exception:  # pragma: no cover — jax is baked into the image
+        return "host"
+
+
 def _percentile(sorted_vals: list[float], q: float) -> float:
     k = len(sorted_vals)
     return sorted_vals[max(0, min(k - 1, math.ceil(q * k) - 1))]
@@ -65,9 +87,12 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
 
 def collect(trc=None) -> dict:
     """Per-kernel {phase: {p50_ms, p95_ms, count}} from the tracer ring's
-    `kernel.*` spans, keyed by kernel name + shape meta."""
+    `kernel.*` spans, keyed by execution platform + kernel name + shape
+    meta (`compare` intersects keys, so a row collected on one platform
+    can never gate — or ratchet — a row from another)."""
     if trc is None:
         from dds_tpu.utils.trace import tracer as trc  # late: avoid cycles
+    plat = platform()
     groups: dict[str, dict[str, list[float]]] = {}
     for e in trc.events():
         if e.kind != "span" or not e.name.startswith("kernel."):
@@ -78,7 +103,7 @@ def collect(trc=None) -> dict:
         shape = ",".join(
             f"{k}={e.meta[k]}" for k in SHAPE_KEYS if k in e.meta
         )
-        key = f"{base}[{shape}]" if shape else base
+        key = f"{plat}::{base}[{shape}]" if shape else f"{plat}::{base}"
         groups.setdefault(key, {}).setdefault(phase, []).append(e.dur_ms)
     out: dict = {}
     for key, phases in sorted(groups.items()):

@@ -443,6 +443,16 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     dep = Deployment(cfg, net, replicas, supervisor, server, trudy, ssl_client,
                      stoppables)
 
+    # per-process identity: the dds_process_info gauge on /metrics and the
+    # flight recorder's incident headers (obs/panopticon correlates by it)
+    from dds_tpu.obs.flight import flight as _flight
+    from dds_tpu.obs.panopticon import process_info
+
+    _flight.configure(
+        identity={"host": local_hostport or "local", "role": "single"}
+    )
+    process_info(role="single")
+
     if cfg.recovery.snapshot_dir and cfg.recovery.snapshot_interval > 0:
         from dds_tpu.core import snapshot as snap
 
@@ -622,6 +632,11 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
     dep = Deployment(cfg, net, replicas, None, server,
                      const.groups[0].trudy, ssl_client, stoppables,
                      constellation=const)
+    from dds_tpu.obs.flight import flight as _flight
+    from dds_tpu.obs.panopticon import process_info
+
+    _flight.configure(identity={"host": "local", "role": "constellation"})
+    process_info(role="constellation")
     if cfg.obs.audit_enabled:
         from dds_tpu.obs.watchtower import watchtower
         from dds_tpu.utils.trace import tracer as _tracer

@@ -196,6 +196,43 @@ class ClientSettings:
 
 
 @dataclass
+class FleetObsConfig:
+    """Panopticon fleet observability plane (dds_tpu/obs/panopticon):
+    every non-proxy Meridian process ships completed span trees, flight
+    incidents, and metric/SLO snapshots to the proxy-role collector over
+    the existing TcpNet fabric; the collector stitches cross-host traces
+    back into single trees for the Watchtower (re-arming quorum audits on
+    multi-host splits), federates /fleet/metrics and /fleet/slo, and
+    correlates incidents fleet-wide at /fleet/incidents. DEPLOY.md
+    "Fleet observability (Panopticon)" is the runbook."""
+
+    enabled: bool = False
+    # collector transport "host:port" (the PROXY process's [transport]
+    # bind). Empty on the proxy role itself — the collector listens on
+    # the process's own TcpNet under the "panopticon" endpoint name.
+    collector: str = ""
+    # telemetry-batch HMAC secret; empty = derive from
+    # security.abd-mac-secret (telemetry is integrity-checked, but a
+    # Byzantine host can still lie about its OWN stats — see DEPLOY.md)
+    secret: str = ""
+    # shipper spool bound (completed span TREES, not spans). Overflow
+    # drops the oldest tree and increments
+    # dds_fleet_ship_dropped_total{reason="spool_overflow"} — the request
+    # path is never blocked by telemetry.
+    spool_max: int = 256
+    # max span trees per shipped batch and the flush-loop period
+    batch_max: int = 32
+    flush_interval: float = 0.25
+    # how long the collector holds a locally-completed root span before
+    # replaying the stitched tree into the Watchtower (remote handler
+    # spans must cross a socket + one flush interval to arrive)
+    stitch_window: float = 1.0
+    # a federated source whose last batch is older than this is marked
+    # stale in /fleet/metrics and /fleet/slo (0 disables marking)
+    staleness: float = 10.0
+
+
+@dataclass
 class ObsConfig:
     """Telescope (dds_tpu/obs) wiring. Env-flag twins exist for harnesses
     that cannot pass a config: DDS_OBS_FLIGHT_DIR / DDS_OBS_FLIGHT_MAX /
@@ -239,6 +276,8 @@ class ObsConfig:
     slo_burn_alert: float = 14.4
     # route name -> {"objective": float, "latency-ms": float}
     slo_routes: dict = field(default_factory=dict)
+    # Panopticon fleet plane ([obs.fleet] in TOML)
+    fleet: FleetObsConfig = field(default_factory=FleetObsConfig)
 
 
 @dataclass
@@ -455,7 +494,10 @@ class FabricConfig:
 class AttackConfig:
     enabled: bool = False
     # crash | byzantine | partition | delay | flood | heal (the network
-    # attacks need chaos_enabled so a ChaosNet fabric exists to drive)
+    # attacks need chaos_enabled so a ChaosNet fabric exists to drive).
+    # "stale_tag" arms a Meridian group process's replicas as
+    # properly-MAC'd stale-read forgers (malicious/trudy.StaleTagForger)
+    # — the cross-host audit regression schedule.
     type: str = "byzantine"
     # wrap the transport in a seeded ChaosNet (core/chaos.py) and use the
     # Nemesis driver, so deployments can soak under deterministic network
@@ -536,4 +578,5 @@ _SUBSECTIONS = {
     ("DDSConfig", "fabric"): FabricConfig,
     ("DDSConfig", "crypto"): CryptoConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
+    ("ObsConfig", "fleet"): FleetObsConfig,
 }

@@ -1,0 +1,744 @@
+"""Panopticon fleet-observability plane tests.
+
+Unit layer (in-process, tier-1): span-shipper spool/drop accounting and
+batch MACs, collector MAC rejection, cross-host trace stitching replayed
+into a Watchtower (forged stale tag over a simulated TCP hop -> exactly
+the tag_monotonicity + quorum_intersection verdicts; the honest schedule
+is verdict-free), Prometheus exposition federation/relabeling, fleet SLO
+burn rollup (worst-of and sum-of), incident correlation by trace id, the
+`dds_process_info` identity gauge, the hostile-`tc`-frame ingest clamp,
+and the sentry `fleet obs` record contract. Flagship layer (slow): a
+3-OS-process loopback Meridian fleet with one group's replicas armed as
+stale-tag forgers — the proxy's collector-fed Watchtower must catch the
+forgery across real sockets, and the identical clean fleet must not.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from dds_tpu.core import messages as M
+from dds_tpu.obs import context as obs_context
+from dds_tpu.obs.metrics import Registry, metrics
+from dds_tpu.obs.panopticon import (FleetCollector, NullWatchtower,
+                                    SpanShipper, batch_mac, merge_expositions,
+                                    parse_samples, process_info,
+                                    record_from_dict)
+from dds_tpu.obs.watchtower import Watchtower
+from dds_tpu.utils import sigs
+from dds_tpu.utils.tasks import supervised_task
+from dds_tpu.utils.trace import SpanRecord, Tracer
+
+pytestmark = pytest.mark.obs
+
+SECRET = b"panopticon-test-secret"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class LoopNet:
+    """Transport stub with the TcpNet surface the plane uses: endpoint
+    registry keyed by name, local_addr() composition, and fire-and-forget
+    send that records every frame and dispatches registered handlers."""
+
+    def __init__(self, advertised="127.0.0.1:1"):
+        self.advertised = advertised
+        self.handlers = {}
+        self.sent = []
+
+    def local_addr(self, name: str) -> str:
+        return f"{self.advertised}/{name}"
+
+    def register(self, addr: str, handler) -> None:
+        self.handlers[addr.rsplit("/", 1)[-1]] = handler
+
+    def unregister(self, addr: str) -> None:
+        self.handlers.pop(addr.rsplit("/", 1)[-1], None)
+
+    def send(self, src: str, dest: str, msg) -> None:
+        self.sent.append((src, dest, msg))
+        h = self.handlers.get(dest.rsplit("/", 1)[-1])
+        if h is not None:
+            supervised_task(h(src, msg), name="loopnet.deliver")
+
+
+def make_shipper(net=None, tracer=None, registry=None, **kw):
+    net = net if net is not None else LoopNet("127.0.0.1:71")
+    tracer = tracer if tracer is not None else Tracer()
+    registry = registry if registry is not None else Registry()
+    kw.setdefault("collector", "127.0.0.1:70")
+    kw.setdefault("secret", SECRET)
+    kw.setdefault("host", "127.0.0.1:71")
+    kw.setdefault("role", "group:s0")
+    kw.setdefault("shard", "s0")
+    kw.setdefault("flush_interval", 0.01)
+    sh = SpanShipper(net, tracer=tracer, registry=registry, **kw)
+    return sh, net, tracer, registry
+
+
+def make_batch(trees, *, host="ghost", role="group:s0", shard="s0", seq=1,
+               incidents=(), metrics_text="", slo=None, dropped=0,
+               secret=SECRET):
+    slo = slo if slo is not None else {}
+    incidents = list(incidents)
+    mac = batch_mac(secret, host, role, shard, seq, 123.0, trees, incidents,
+                    metrics_text, slo, dropped)
+    return M.TelemetryBatch(host=host, role=role, shard=shard, seq=seq,
+                            ts=123.0, spans=trees, incidents=incidents,
+                            metrics_text=metrics_text, slo=slo,
+                            dropped=dropped, mac=mac)
+
+
+# ----------------------------------------------------------- identity gauge
+
+
+def test_process_info_gauge_carries_identity_labels():
+    reg = Registry()
+    process_info(reg, role="group:s0", shard="s0")
+    samples = parse_samples(reg.render(), "dds_process_info")
+    assert len(samples) == 1
+    labels, value = samples[0]
+    assert value == 1.0
+    assert labels["role"] == "group:s0" and labels["shard"] == "s0"
+    assert labels["pid"] == str(os.getpid())
+    assert float(labels["start_ts"]) > 0
+    assert labels["version"]
+
+    # no shard -> placeholder label, never an empty value
+    reg2 = Registry()
+    process_info(reg2, role="proxy")
+    (labels2, _), = parse_samples(reg2.render(), "dds_process_info")
+    assert labels2["shard"] == "-"
+
+
+# ------------------------------------------------------------ wire helpers
+
+
+def test_record_from_dict_roundtrips_and_survives_garbage():
+    t = Tracer()
+    with t.span("abd.fetch", key="K"):
+        pass
+    d = Tracer.event_dict(t.events()[0])
+    rec = record_from_dict(d)
+    assert isinstance(rec, SpanRecord)
+    assert rec.name == "abd.fetch" and rec.meta == {"key": "K"}
+    assert rec.trace_id == d["trace_id"] and rec.parent_id is None
+
+    assert record_from_dict({}) is None
+    assert record_from_dict({"name": "x"}) is None          # no ts
+    assert record_from_dict({"ts": None, "name": "x"}) is None
+    assert record_from_dict({"ts": "junk", "name": "x"}) is None
+    # non-dict meta degrades to {} instead of poisoning the audit
+    ok = record_from_dict({"ts": 1.0, "name": "x", "meta": ["not-a-dict"]})
+    assert ok is not None and ok.meta == {}
+
+
+def test_batch_mac_is_payload_sensitive():
+    args = ("h", "group:s0", "s0", 1, 2.0, [["x"]], [], "m", {}, 0)
+    base = batch_mac(SECRET, *args)
+    assert base == batch_mac(SECRET, *args)
+    assert base != batch_mac(b"other-key", *args)
+    tampered = ("h", "group:s0", "s0", 1, 2.0, [["y"]], [], "m", {}, 0)
+    assert base != batch_mac(SECRET, *tampered)
+
+
+# ------------------------------------------------------------------ shipper
+
+
+def test_shipper_ships_quiesced_trees_as_signed_batches():
+    async def go():
+        sh, net, t, reg = make_shipper()
+        t.subscribe(sh.on_record)
+        with t.span("replica.handle", replica="s0-replica-1", msg="Read",
+                    key="K"):
+            pass
+        t.unsubscribe(sh.on_record)
+        await asyncio.sleep(0.03)  # quiesce past the flush interval
+        await sh._flush_once()
+        assert len(net.sent) == 1
+        src, dest, batch = net.sent[0]
+        assert dest == "127.0.0.1:70/panopticon"
+        assert isinstance(batch, M.TelemetryBatch)
+        assert (batch.host, batch.role, batch.shard) == \
+            ("127.0.0.1:71", "group:s0", "s0")
+        assert batch.seq == 1 and batch.dropped == 0
+        names = [d["name"] for tree in batch.spans for d in tree]
+        assert names == ["replica.handle"]
+        # the MAC covers exactly the shipped payload
+        assert batch.mac == batch_mac(
+            SECRET, batch.host, batch.role, batch.shard, batch.seq, batch.ts,
+            batch.spans, batch.incidents, batch.metrics_text, batch.slo,
+            batch.dropped,
+        )
+        # nothing new + heartbeat not yet due -> no frame
+        await sh._flush_once()
+        assert len(net.sent) == 1
+        # heartbeat due -> empty-span liveness batch carrying the process
+        # metrics snapshot even with no local SloEngine
+        sh._last_ship = 0.0
+        await sh._flush_once()
+        assert len(net.sent) == 2 and net.sent[1][2].spans == []
+        assert "dds_fleet_ship_batches_total" in net.sent[1][2].metrics_text
+
+    run(go())
+
+
+def test_shipper_spool_overflow_drops_oldest_and_accounts():
+    async def go():
+        sh, net, t, reg = make_shipper(spool_max=2, batch_max=1)
+        t.subscribe(sh.on_record)
+        for i in range(4):
+            with t.span(f"op{i}"):  # four distinct single-span traces
+                pass
+        t.unsubscribe(sh.on_record)
+        assert sh.stats()["active_traces"] == 4
+        await asyncio.sleep(0.03)
+        trees = sh._collect_quiesced()
+        # batch_max caps the flight; spool_max bounds the backlog: of the
+        # four quiesced trees one ships, one stays spooled, two dropped
+        assert len(trees) == 1 and sh.stats()["spooled_trees"] == 1
+        assert sh.stats()["dropped"] == 2
+        assert reg.value("dds_fleet_ship_dropped_total",
+                         reason="spool_overflow") == 2
+
+        # a rejecting ack is a drop too — accounted, never retried
+        await sh.handle("c", M.TelemetryAck(seq=9, ok=False, error="bad mac"))
+        assert sh.stats()["dropped"] == 3
+        assert reg.value("dds_fleet_ship_dropped_total", reason="rejected") == 1
+
+    run(go())
+
+
+def test_shipper_never_ships_breaker_noise_without_trace_but_keeps_events():
+    async def go():
+        sh, net, t, reg = make_shipper()
+        t.subscribe(sh.on_record)
+        t.event("breaker.open", target="s0-replica-2")     # loose: shipped
+        t.record("cache.miss", 0.0, _kind="event")         # loose: ignored
+        t.unsubscribe(sh.on_record)
+        await asyncio.sleep(0.03)
+        await sh._flush_once()
+        (_, _, batch), = net.sent
+        names = [d["name"] for tree in batch.spans for d in tree]
+        assert names == ["breaker.open"]
+
+    run(go())
+
+
+# ---------------------------------------------------------------- collector
+
+
+def make_collector(net=None, wt=None, tracer=None, registry=None, **kw):
+    net = net if net is not None else LoopNet("127.0.0.1:70")
+    wt = wt if wt is not None else Watchtower(quorum_size=3, n_replicas=4)
+    tracer = tracer if tracer is not None else Tracer()
+    registry = registry if registry is not None else Registry()
+    kw.setdefault("secret", SECRET)
+    kw.setdefault("host", "127.0.0.1:70")
+    kw.setdefault("stitch_window", 0.05)
+    col = FleetCollector(net, watchtower=wt, tracer=tracer, registry=registry,
+                         **kw)
+    return col, net, wt, tracer, registry
+
+
+def test_collector_rejects_bad_mac_with_ack_and_counter():
+    async def go():
+        col, net, wt, t, reg = make_collector()
+        batch = make_batch([], secret=b"wrong-secret")
+        await col.handle("g:1/panopticon-ship", batch)
+        assert col.stats()["sources"] == []
+        assert reg.value("dds_fleet_collect_rejected_total", reason="mac") == 1
+        (_, dest, ack), = net.sent
+        assert dest == "g:1/panopticon-ship"
+        assert isinstance(ack, M.TelemetryAck)
+        assert not ack.ok and ack.error == "bad mac" and ack.seq == 1
+
+        # properly-signed batch from the same peer lands and acks ok
+        await col.handle("g:1/panopticon-ship", make_batch([], seq=2))
+        assert col.stats()["sources"] == ["ghost"]
+        assert net.sent[-1][2].ok
+
+    run(go())
+
+
+def _commit(t, name, key, seq, tag_id, coordinator="s0-replica-0"):
+    """Proxy-local half of a cross-host op: root http span + the quorum
+    client's committed abd span. Returns the abd span's context so remote
+    handler spans can be forged as its children."""
+    ctx = {}
+    with t.span(f"http.{name}"):
+        with t.span(
+            "abd.write" if name == "write" else "abd.fetch",
+            coordinator=coordinator, ok=True,
+            op="write" if name == "write" else "read",
+            key=key, seq=seq, tag_id=tag_id,
+        ):
+            ctx["abd"] = obs_context.current()
+    return ctx["abd"]
+
+
+def _remote_handlers(ctx, phases):
+    """Shipped replica.handle spans (a remote group process's vantage),
+    children of the proxy's abd span via the propagated tc context."""
+    return [
+        {
+            "ts": time.time(), "name": "replica.handle", "dur_ms": 0.3,
+            "kind": "span", "trace_id": ctx.trace_id,
+            "span_id": os.urandom(8).hex(), "parent_id": ctx.span_id,
+            "meta": {"replica": replica, "msg": msg, "key": "K"},
+        }
+        for msg, replica in phases
+    ]
+
+
+R4 = [f"s0-replica-{i}" for i in range(4)]
+
+
+def test_collector_stitches_cross_host_trace_and_audits_forgery():
+    """Satellite-c in-process smoke: two honest cross-host write commits
+    (handler spans arrive by TelemetryBatch, not the local tracer), then a
+    read committing a forged stale tag with NO remote quorum behind it.
+    The collector-fed Watchtower must emit exactly tag_monotonicity +
+    quorum_intersection, both blaming the forged read's trace."""
+
+    async def go():
+        col, net, wt, t, reg = make_collector()
+        t.subscribe(col.on_record)
+        seq = 0
+        for wseq in (1, 2):
+            ctx = _commit(t, "write", "K", wseq, "s0-replica-0")
+            seq += 1
+            tree = _remote_handlers(
+                ctx,
+                [("ReadTag", r) for r in R4[:3]]
+                + [("Write", r) for r in R4[:3]],
+            )
+            await col.handle("g/panopticon-ship", make_batch([tree], seq=seq))
+            await asyncio.sleep(0.06)  # past the stitch window
+            col._replay_due()
+            await asyncio.sleep(0.005)  # strict real-time commit order
+        assert wt.verdicts() == []
+        assert col.stats()["traces_stitched"] == 2
+
+        # the forgery: a committed stale read no remote process vouches for
+        _commit(t, "read", "K", 1, "forged", coordinator="s0-replica-3")
+        await asyncio.sleep(0.06)
+        col._replay_due()
+        vs = wt.verdicts()
+        by_inv = {v.invariant: v for v in vs}
+        assert set(by_inv) == {"tag_monotonicity", "quorum_intersection"}
+        assert by_inv["tag_monotonicity"].detail["tag"] == [1, "forged"]
+        tid = by_inv["tag_monotonicity"].trace_id
+        assert tid is not None
+        assert by_inv["quorum_intersection"].trace_id == tid
+        t.unsubscribe(col.on_record)
+
+    run(go())
+
+
+def test_collector_audits_each_trace_once_despite_stragglers():
+    async def go():
+        col, net, wt, t, reg = make_collector()
+        t.subscribe(col.on_record)
+        ctx = _commit(t, "write", "K", 1, "s0-replica-0")
+        tree = _remote_handlers(
+            ctx, [("ReadTag", r) for r in R4[:3]] + [("Write", r) for r in R4[:3]]
+        )
+        await col.handle("g/panopticon-ship", make_batch([tree], seq=1))
+        await asyncio.sleep(0.06)
+        col._replay_due()
+        assert col.stats()["traces_stitched"] == 1
+        # a straggler span for the audited trace must not re-open it
+        await col.handle("g/panopticon-ship",
+                         make_batch([tree[:1]], seq=2))
+        await asyncio.sleep(0.06)
+        col._replay_due()
+        assert col.stats()["traces_stitched"] == 1
+        assert col.stats()["pending_traces"] == 0
+        assert wt.verdicts() == []
+        t.unsubscribe(col.on_record)
+
+    run(go())
+
+
+def test_null_watchtower_sinks_replays():
+    async def go():
+        sink = NullWatchtower()
+        col, net, _, t, reg = make_collector(wt=sink)
+        t.subscribe(col.on_record)
+        _commit(t, "read", "K", 1, "forged")
+        await asyncio.sleep(0.06)
+        col._replay_due()
+        assert col.stats()["traces_stitched"] == 1
+        assert sink.verdicts() == []
+        t.unsubscribe(col.on_record)
+
+    run(go())
+
+
+# --------------------------------------------------------------- federation
+
+
+def test_merge_expositions_relabels_and_emits_headers_once():
+    src_a = (
+        "# HELP dds_requests_total requests\n"
+        "# TYPE dds_requests_total counter\n"
+        'dds_requests_total{route="GetSet"} 3\n'
+    )
+    src_b = (
+        "# HELP dds_requests_total requests\n"
+        "# TYPE dds_requests_total counter\n"
+        "dds_requests_total 5\n"
+        "# TYPE dds_lat histogram\n"
+        'dds_lat_bucket{le="+Inf"} 2\n'
+        "dds_lat_sum 0.25\n"
+        "dds_lat_count 2\n"
+    )
+    doc = merge_expositions([
+        {"labels": {"host": "h1", "role": "proxy"}, "text": src_a},
+        {"labels": {"host": "h2", "role": "group:s0", "shard": "s0"},
+         "text": src_b},
+    ])
+    assert doc.count("# HELP dds_requests_total") == 1
+    assert doc.count("# TYPE dds_requests_total counter") == 1
+    assert 'dds_requests_total{host="h1",role="proxy",route="GetSet"} 3' in doc
+    assert ('dds_requests_total{host="h2",role="group:s0",shard="s0"} 5'
+            in doc)
+    # histogram suffix lines stay grouped under their family, relabeled
+    lines = doc.splitlines()
+    fam_at = lines.index("# TYPE dds_lat histogram")
+    assert lines[fam_at + 1].startswith('dds_lat_bucket{host="h2"')
+    assert 'dds_lat_sum{host="h2",role="group:s0",shard="s0"} 0.25' in doc
+    assert 'dds_lat_count{host="h2",role="group:s0",shard="s0"} 2' in doc
+
+
+def test_parse_samples_reads_labeled_and_bare_series():
+    reg = Registry()
+    reg.set("dds_resident_rows", 42, shard="s0")
+    reg.set("dds_resident_rows", 7, shard="s1")
+    reg.set("dds_admission_shed_level", 2)
+    text = reg.render()
+    rows = dict((lab["shard"], v)
+                for lab, v in parse_samples(text, "dds_resident_rows"))
+    assert rows == {"s0": 42.0, "s1": 7.0}
+    assert parse_samples(text, "dds_admission_shed_level") == [({}, 2.0)]
+    assert parse_samples(text, "dds_absent_series") == []
+
+
+def test_fleet_metrics_labels_every_source_and_marks_staleness():
+    async def go():
+        col, net, wt, t, reg = make_collector(staleness=5.0)
+        reg.set("dds_up", 1)
+        await col.handle("g/panopticon-ship", make_batch(
+            [], host="10.0.0.7:7100", role="group:s0", shard="s0",
+            metrics_text="# TYPE dds_up gauge\ndds_up 1\n", dropped=3,
+        ))
+        col.sample_gauges()
+        doc = col.fleet_metrics()
+        assert 'dds_up{host="127.0.0.1:70",role="proxy"} 1' in doc
+        assert ('dds_up{host="10.0.0.7:7100",role="group:s0",shard="s0"} 1'
+                in doc)
+        assert 'dds_fleet_source_stale{host="10.0.0.7:7100",' \
+            'role="group:s0"} 0' in doc
+        assert 'dds_fleet_ship_dropped_by_source{host="10.0.0.7:7100"} 3' \
+            in doc
+        # age the source past the staleness horizon
+        col._sources["10.0.0.7:7100"]["mono"] -= 60.0
+        doc = col.fleet_metrics()
+        assert 'dds_fleet_source_stale{host="10.0.0.7:7100",' \
+            'role="group:s0"} 1' in doc
+        ages = parse_samples(doc, "dds_fleet_source_age_seconds")
+        assert {a["host"] for a, _ in ages} == {"127.0.0.1:70",
+                                                "10.0.0.7:7100"}
+
+    run(go())
+
+
+def test_fleet_slo_rolls_up_worst_of_and_sum_of_burn():
+    async def go():
+        col, net, wt, t, reg = make_collector()
+
+        def slo_for(total, bad, burn):
+            return {"routes": {"GetSet": {
+                "objective": 0.99, "class": "interactive",
+                "windows": {"5m": {"total": total, "bad": bad,
+                                   "burn_rate": burn}},
+            }}}
+
+        await col.handle("a/s", make_batch(
+            [], host="hA", role="group:s0", shard="s0", seq=1,
+            slo=slo_for(100, 2, 2.0),
+            metrics_text=('dds_resident_rows{shard="s0"} 10\n'
+                          'dds_resident_bytes{shard="s0"} 4096\n'
+                          "dds_admission_shed_level 1\n"),
+        ))
+        await col.handle("b/s", make_batch(
+            [], host="hB", role="group:s1", shard="s1", seq=1,
+            slo=slo_for(300, 0, 0.5),
+            metrics_text=('dds_resident_rows{shard="s1"} 7\n'
+                          "dds_admission_shed_level 3\n"),
+        ))
+        rep = col.fleet_slo()
+        assert set(rep["hosts"]) == {"127.0.0.1:70", "hA", "hB"}
+        assert rep["hosts"]["hA"]["role"] == "group:s0"
+        w = rep["fleet"]["routes"]["GetSet"]["windows"]["5m"]
+        assert w["total"] == 400 and w["bad"] == 2
+        assert w["burn_rate_worst"] == 2.0
+        # pooled: (2/400) / (1 - 0.99) = 0.5
+        assert w["burn_rate_sum_of"] == 0.5
+        assert rep["fleet"]["resident"]["s0"] == {
+            "rows": 10.0, "host": "hA", "bytes": 4096.0,
+        }
+        assert rep["fleet"]["resident"]["s1"]["rows"] == 7.0
+        assert rep["fleet"]["shed_level"] == {"hA": 1.0, "hB": 3.0}
+        assert rep["fleet"]["shed_level_max"] == 3.0
+
+    run(go())
+
+
+def test_fleet_incidents_correlate_by_trace_id():
+    async def go():
+        col, net, wt, t, reg = make_collector()
+        await col.handle("a/s", make_batch(
+            [], host="hA", role="group:s0", shard="s0",
+            incidents=[{"trace_id": "aa11", "reason": "audit"},
+                       {"reason": "panic"}],
+        ))
+        await col.handle("b/s", make_batch(
+            [], host="hB", role="group:s1", shard="s1",
+            incidents=[{"trace_id": "aa11", "reason": "audit"}],
+        ))
+        rep = col.fleet_incidents()
+        assert rep["count"] == 3
+        # shipped entries are attributed to their source process
+        assert {(e["host"], e["role"]) for e in rep["incidents"]} == {
+            ("hA", "group:s0"), ("hB", "group:s1"),
+        }
+        # the fleet-wide why: both hosts' incidents share the trace
+        assert [e["host"] for e in rep["by_trace"]["aa11"]] == ["hA", "hB"]
+        only = col.fleet_incidents("aa11")
+        assert only["count"] == 2 and set(only["by_trace"]) == {"aa11"}
+        assert rep["verdicts"] == []
+
+    run(go())
+
+
+# ------------------------------------------- satellite-a: hostile tc ingest
+
+
+def test_hostile_tc_frame_field_is_clamped_counted_and_non_fatal():
+    """An unauthenticated peer spraying malformed `tc` fields must not
+    drop messages or kill the shared connection: every frame dispatches,
+    the garbage degrades to an unlinked span context, and the malformed
+    counter accounts each refusal."""
+    from dds_tpu.core.transport import TcpNet
+
+    async def go():
+        net = TcpNet("127.0.0.1", 0)
+        await net.start()
+        got = []
+
+        async def handler(src, msg):
+            got.append((msg.seq, obs_context.current()))
+
+        net.register(net.local_addr("victim"), handler)
+        before = metrics.value("dds_trace_context_malformed_total") or 0
+        try:
+            _, writer = await asyncio.open_connection("127.0.0.1", net.port)
+            hostile = [
+                "garbage-not-a-dict",
+                {"t": "gg" * 8, "s": "ab12" * 4},   # non-hex chars
+                {"t": "a" * 40, "s": "ab12" * 4},   # oversized id
+                {"t": "ab12" * 4, "s": 12345},      # non-string id
+            ]
+            frames = [(i, tc) for i, tc in enumerate(hostile)]
+            frames.append((4, {"t": "ab12" * 4, "s": "cd34" * 4}))  # valid
+            frames.append((5, None))                                # absent
+            for seq, tc in frames:
+                obj = {
+                    "src": "10.6.6.6:666/evil",
+                    "dest": f"{net.advertised}/victim",
+                    "msg": M.to_dict(M.TelemetryAck(seq=seq, ok=True)),
+                }
+                if tc is not None:
+                    obj["tc"] = tc
+                frame = json.dumps(obj).encode()
+                writer.write(len(frame).to_bytes(4, "big") + frame)
+            await writer.drain()
+            deadline = time.monotonic() + 5.0
+            while len(got) < 6 and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            writer.close()
+        finally:
+            await net.stop()
+
+        # the connection survived: every message (incl. the ones behind
+        # the garbage) dispatched, in order
+        assert [seq for seq, _ in got] == [0, 1, 2, 3, 4, 5]
+        by_seq = dict(got)
+        # hostile contexts refused wholesale; valid one restored; absent
+        # one simply unlinked
+        for seq in (0, 1, 2, 3, 5):
+            assert by_seq[seq] is None
+        assert by_seq[4] is not None
+        assert by_seq[4].trace_id == "ab12" * 4
+        assert (metrics.value("dds_trace_context_malformed_total") or 0) \
+            == before + 4
+
+    run(go())
+
+
+# -------------------------------------------- sentry `fleet obs` contract
+
+
+def test_sentry_validates_fleet_obs_records(tmp_path):
+    from benchmarks.sentry import _check_fleet_obs_records
+
+    good = {
+        "metric": "fleet obs", "value": 53.3, "unit": "req/s",
+        "vs_baseline": 1.0,
+        "detail": {
+            "rate": 80.0, "duration": 2.0, "processes": 3,
+            "open_loop": True, "on_good": 107, "off_good": 110,
+            "overhead_pct": 2.73, "sources": 2, "stitched": 40,
+            "dropped": 0,
+        },
+    }
+    bench = tmp_path / "benchmarks"
+    bench.mkdir()
+    (bench / "results.json").write_text(json.dumps([good]))
+    assert _check_fleet_obs_records(str(tmp_path)) == {"rows": 1}
+    for mutate in (
+        {"value": 0},                                       # no goodput
+        {"detail": dict(good["detail"], processes=1)},      # not a fleet
+        {"detail": dict(good["detail"], open_loop=False)},
+        {"detail": dict(good["detail"], on_good=0)},        # nothing served
+        {"detail": dict(good["detail"], overhead_pct="2%")},
+        {"detail": dict(good["detail"], sources=0)},        # plane not live
+        {"detail": dict(good["detail"], stitched=-1)},
+        {"detail": dict(good["detail"], dropped=None)},     # unaccounted
+    ):
+        (bench / "results.json").write_text(json.dumps([dict(good, **mutate)]))
+        with pytest.raises(ValueError):
+            _check_fleet_obs_records(str(tmp_path))
+    # absent files / other families never fail the smoke
+    (bench / "results.json").write_text(json.dumps([{"metric": "sweep"}]))
+    assert _check_fleet_obs_records(str(tmp_path)) == {"rows": 0}
+
+
+# --------------------------------- flagship: real OS processes, real attack
+
+
+def _fleet_key_owned_by(gid: str) -> tuple[list, str]:
+    """(contents, key) for a PutSet whose content-hash key lands in `gid`
+    under the fleet's deterministic epoch-1 map (S=2, default vnodes)."""
+    from dds_tpu.shard.shardmap import ShardMap
+    from dds_tpu.utils.config import DDSConfig
+
+    smap = ShardMap.build(["s0", "s1"], DDSConfig().shard.vnodes_per_group)
+    for i in range(4096):
+        contents = [f"panopticon-{i}"]
+        key = sigs.key_from_set(contents)
+        if smap.owner(key) == gid:
+            return contents, key
+    raise AssertionError("no key hashed into the target group")
+
+
+def _panopticon_fleet(workdir, attack: bool):
+    from benchmarks.multihost_load import Fleet
+
+    fleet = Fleet(str(workdir), proxy_audit=True)
+    ship_stanza = (
+        "\n[obs.fleet]\nenabled = true\n"
+        f'collector = "{fleet.proxy_transport}"\n'
+        "flush-interval = 0.1\n"
+    )
+    forge_stanza = '\n[attacks]\nenabled = true\ntype = "stale_tag"\n'
+    fleet.group_extra = {
+        gid: ship_stanza + (forge_stanza if attack and gid == "s0" else "")
+        for gid in fleet.gids
+    }
+    fleet.proxy_extra = "\n[obs.fleet]\nenabled = true\nstitch-window = 1.5\n"
+    return fleet
+
+
+async def _forged_fleet_schedule(workdir, attack: bool):
+    """Two honest writes then one read of an s0-owned key against a REAL
+    3-OS-process loopback fleet; with `attack`, every s0 replica forges
+    properly-MAC'd stale read replies. Returns (read contents, the
+    /fleet/incidents report, the /fleet/metrics text)."""
+    from dds_tpu.http.miniserver import http_request
+
+    contents, key = _fleet_key_owned_by("s0")
+    workdir.mkdir(parents=True, exist_ok=True)
+    fleet = _panopticon_fleet(workdir, attack)
+    try:
+        fleet.start()
+        await fleet.wait_healthy(timeout=120.0)
+        port = int(fleet.proxy_targets[0].rsplit(":", 1)[1])
+        for _ in range(2):  # same contents -> same key: two commits on it
+            status, body = await http_request(
+                "127.0.0.1", port, "POST", "/PutSet",
+                json.dumps({"contents": contents}).encode(), timeout=30.0)
+            assert status == 200 and body.decode() == key
+            await asyncio.sleep(0.05)  # strict real-time commit order
+        status, body = await http_request(
+            "127.0.0.1", port, "GET", f"/GetSet/{key}", timeout=30.0)
+        assert status == 200
+        value = json.loads(body)["contents"]
+        # let the group processes quiesce + ship (flush 0.1) and the
+        # collector replay the stitched trees (stitch window 1.5)
+        await asyncio.sleep(4.0)
+        status, body = await http_request(
+            "127.0.0.1", port, "GET", "/fleet/incidents", timeout=10.0)
+        assert status == 200
+        report = json.loads(body)
+        status, mbody = await http_request(
+            "127.0.0.1", port, "GET", "/fleet/metrics", timeout=10.0)
+        assert status == 200
+        return value, report, mbody.decode()
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.multihost
+def test_flagship_cross_host_stale_tag_forgery_is_caught(tmp_path):
+    """Satellite-c acceptance on real OS processes: the s0 group process
+    forges a stale read across the socket; the proxy's collector-fed
+    Watchtower emits exactly tag_monotonicity + quorum_intersection, both
+    blaming the forged read's cross-host trace."""
+    value, report, mtext = run(
+        _forged_fleet_schedule(tmp_path / "attack", attack=True)
+    )
+    assert value == ["stale"]  # the forgery really landed at the client
+    verdicts = report["verdicts"]
+    by_inv = {v["invariant"]: v for v in verdicts}
+    assert set(by_inv) == {"tag_monotonicity", "quorum_intersection"}, verdicts
+    assert by_inv["tag_monotonicity"]["detail"]["tag"] == [1, "forged"]
+    tid = by_inv["tag_monotonicity"]["trace_id"]
+    assert tid and by_inv["quorum_intersection"]["trace_id"] == tid
+    # federation saw every host, labeled by role
+    assert 'role="group:s0"' in mtext and 'role="group:s1"' in mtext
+    assert 'role="proxy"' in mtext
+    assert "dds_fleet_source_age_seconds" in mtext
+
+
+@pytest.mark.slow
+@pytest.mark.multihost
+def test_flagship_clean_fleet_schedule_is_verdict_free(tmp_path):
+    """The identical schedule minus the forgery: honest value served, the
+    stitched cross-host traces audit clean (quorum checks ENABLED — the
+    shipped replica handler spans are what makes them sound again)."""
+    contents, _ = _fleet_key_owned_by("s0")
+    value, report, mtext = run(
+        _forged_fleet_schedule(tmp_path / "clean", attack=False)
+    )
+    assert value == contents
+    assert report["verdicts"] == [], report["verdicts"]
+    assert 'role="group:s0"' in mtext and 'role="proxy"' in mtext

@@ -557,11 +557,39 @@ def _fake_kernel_trace():
     return t
 
 
-def test_sentry_collect_keys_by_name_and_shape():
+# pin the baseline namespace so these tests (and their CLI subprocesses,
+# which inherit the env) agree on keys regardless of the host's backend
+@pytest.fixture(autouse=True)
+def _pin_sentry_platform(monkeypatch):
+    monkeypatch.setenv("DDS_SENTRY_PLATFORM", "cpu")
+
+
+def test_sentry_collect_keys_by_platform_name_and_shape():
     stats = sentry.collect(_fake_kernel_trace())
-    assert list(stats) == ["foldmany[R=2,P2=2]"]
-    d = stats["foldmany[R=2,P2=2]"]["dispatch"]
+    assert list(stats) == ["cpu::foldmany[R=2,P2=2]"]
+    d = stats["cpu::foldmany[R=2,P2=2]"]["dispatch"]
     assert d["count"] == 5 and d["p50_ms"] == 1.2 and d["p95_ms"] == 1.4
+
+
+def test_sentry_platform_namespacing_never_crosses_environments():
+    """Satellite-f: a CPU-fabric run's rows must not gate (or ratchet)
+    against an on-chip baseline's rows — the platform prefix keeps the
+    key sets disjoint, so compare() has an empty intersection."""
+    cpu_stats = sentry.collect(_fake_kernel_trace())
+    os.environ["DDS_SENTRY_PLATFORM"] = "tpu"
+    try:
+        tpu_stats = sentry.collect(_fake_kernel_trace())
+    finally:
+        os.environ["DDS_SENTRY_PLATFORM"] = "cpu"
+    assert set(cpu_stats).isdisjoint(tpu_stats)
+    # a 10x-slower CPU run vs a TPU baseline: no findings, nothing shared
+    slow_cpu = {k: {ph: {**s, "p50_ms": s["p50_ms"] * 10}
+                    for ph, s in e.items()} for k, e in cpu_stats.items()}
+    assert sentry.compare(tpu_stats, slow_cpu) == []
+    # and a merge into one shared file keeps both environments' rows
+    merged = dict(tpu_stats)
+    merged.update(slow_cpu)
+    assert sentry.compare(merged, slow_cpu) == []  # only cpu rows compare
 
 
 def test_sentry_baseline_roundtrip_and_merge(tmp_path):
@@ -586,7 +614,7 @@ def test_sentry_compare_flags_inflated_timings():
     base = sentry.collect(_fake_kernel_trace())
     fresh = {k: {ph: dict(s) for ph, s in e.items()} for k, e in base.items()}
     assert sentry.compare(base, fresh) == []
-    fresh["foldmany[R=2,P2=2]"]["execute"]["p50_ms"] *= 3  # 3x regression
+    fresh["cpu::foldmany[R=2,P2=2]"]["execute"]["p50_ms"] *= 3  # 3x regression
     findings = sentry.compare(base, fresh, threshold=0.20)
     assert len(findings) == 1
     f = findings[0]
@@ -620,7 +648,7 @@ def test_sentry_cli_gates_on_regression(tmp_path):
     assert p.returncode == 1, p.stdout + p.stderr
     row = json.loads(p.stdout.strip().splitlines()[-1])
     assert row["ok"] is False and row["regressions"]
-    assert row["regressions"][0]["kernel"] == "foldmany[R=2,P2=2]"
+    assert row["regressions"][0]["kernel"] == "cpu::foldmany[R=2,P2=2]"
 
     # identical stats pass the gate
     same = tmp_path / "same.json"
@@ -658,8 +686,8 @@ def test_emit_persists_kernel_baseline(tmp_path, monkeypatch):
     tracer.record("kernel.emit_probe.execute", 3.0, k=4)
     common.emit("m", 1.0, "ops/s", 1.0)
     kernels = sentry.load_baseline(str(path))
-    assert "emit_probe[k=4]" in kernels
-    assert kernels["emit_probe[k=4]"]["execute"]["p50_ms"] == 3.0
+    assert "cpu::emit_probe[k=4]" in kernels
+    assert kernels["cpu::emit_probe[k=4]"]["execute"]["p50_ms"] == 3.0
 
 
 # ------------------------------------------------------- metrics satellite
