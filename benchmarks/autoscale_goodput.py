@@ -1,0 +1,225 @@
+"""Autoscale goodput: Helmsman self-steering fleet vs a static shape.
+
+The claim behind ISSUE 15: a fleet whose shape is fixed at deploy time
+pays for capacity the hotspot is not using (large S) or melts when the
+hotspot lands (small S); a Helmsman-steered fleet splits the hot group
+onto a warm standby when SLO burn plus a dominant load share persist,
+and merges cooled capacity back when the fleet is calm — so goodput per
+group-hour beats any static shape on the same schedule.
+
+The harness drives ONE seeded open-loop schedule twice — controller off
+(static baseline), then on (adaptive) — against a fresh in-memory
+constellation each time:
+
+- a seeded ChaosNet fabric (delivery jitter only — deterministic);
+- an OPEN-LOOP arrival schedule (coordinated-omission-safe) with a
+  migrating hotspot: phase A hammers a key set clustered on one group's
+  ring arc, phase B moves the hotspot to a different group's arc, then a
+  cool tail lets the controller fold capacity back;
+- a capacity model per group (LANES concurrent service lanes at
+  --service-ms each): an op is GOOD iff it completes within --slo-ms of
+  its scheduled arrival, and the score divides good ops by the
+  time-integral of active group count (group-seconds you pay for).
+
+Reported record (`autoscale goodput`, parsed by benchmarks/sentry.py
+--check): value = adaptive goodput per group-second, vs_baseline =
+adaptive / static score, detail = split/merge counts, migrated bytes,
+and both runs' good/group-second censuses.
+
+Usage: python -m benchmarks.autoscale_goodput [--phase 1.0] [--tail 0.9]
+       [--rate 1600] [--static-groups 2] [--seed 23]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import collections
+import random
+import time
+
+from benchmarks.common import emit
+
+
+def _pick_hot(map2, map4, splitmap, owner2, new_gid, per_side=3):
+    """Keys that form a REAL arc hotspot: clustered on `owner2`'s arc in
+    the 2-group ring AND on one group's arc in the 4-group ring, with a
+    midpoint split of `owner2` dividing them between old and new owner —
+    so every fleet shape feels the same hotspot and a split relieves it."""
+    cand = [f"LOAD-{i}" for i in range(400)
+            if map2.owner(f"LOAD-{i}") == owner2]
+    dom = collections.Counter(map4.owner(k) for k in cand).most_common(1)[0][0]
+    cand = [k for k in cand if map4.owner(k) == dom]
+    stay = [k for k in cand if splitmap.owner(k) == owner2][:per_side]
+    move = [k for k in cand if splitmap.owner(k) == new_gid][:per_side]
+    if len(stay) < per_side or len(move) < per_side:
+        raise RuntimeError("hot-key selection failed for this ring layout")
+    return stay + move
+
+
+def _schedule(args):
+    """One seeded open-loop schedule, identical for both variants."""
+    from dds_tpu.shard import ShardMap
+
+    map2 = ShardMap.build(["s0", "s1"], 8)
+    map4 = ShardMap.build(["s0", "s1", "s2", "s3"], 8)
+    split2 = map2.split("s1", "s2")
+    hot_a = _pick_hot(map2, map4, split2, "s1", "s2")
+    hot_b = _pick_hot(map2, map4, split2.split("s0", "s3"), "s0", "s3")
+    uniform = [f"U-{i}" for i in range(52)]
+    universe = uniform + hot_a + hot_b
+
+    rng = random.Random(args.seed)
+    sched, t = [], 0.0
+    while t < 2 * args.phase:
+        t += 1.0 / args.rate
+        hot = hot_a if t < args.phase else hot_b
+        key = (hot[rng.randrange(len(hot))] if rng.random() < args.p_hot
+               else universe[rng.randrange(len(universe))])
+        sched.append((t, key))
+    while t < 2 * args.phase + args.tail:  # cool tail: back on the A side
+        t += 1.0 / args.tail_rate
+        key = (hot_a[rng.randrange(len(hot_a))] if rng.random() < 0.7
+               else universe[rng.randrange(len(universe))])
+        sched.append((t, key))
+    return sched, universe
+
+
+async def _drive(args, sched, universe, adaptive: bool) -> dict:
+    from dds_tpu.core.chaos import ChaosNet, LinkFaults
+    from dds_tpu.core.transport import InMemoryNet
+    from dds_tpu.fleet.helmsman import Helmsman
+    from dds_tpu.shard import build_constellation
+
+    net = ChaosNet(InMemoryNet(), seed=args.seed + 7)
+    net.default_faults = LinkFaults(jitter=args.jitter_ms / 1e3)
+    S = 2 if adaptive else args.static_groups
+    const = build_constellation(
+        net, shard_count=S, vnodes_per_group=8, seed=args.seed,
+        n_active=4, n_sentinent=0, quorum=3,
+    )
+    r = const.router
+    for k in universe:
+        await r.write_set(k, [k])
+
+    service, slo = args.service_ms / 1e3, args.slo_ms / 1e3
+    lanes: dict[str, asyncio.Semaphore] = {}
+    counts: dict[str, int] = {}
+    stats = {"good": 0, "backlog": 0, "integral": 0.0}
+    t0 = time.perf_counter()
+
+    async def op(due: float, key: str):
+        delay = due - (time.perf_counter() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        stats["backlog"] += 1
+        gid = r.owner(key)
+        counts[gid] = counts.get(gid, 0) + 1
+        sem = lanes.setdefault(gid, asyncio.Semaphore(args.lanes))
+        async with sem:
+            await asyncio.sleep(service)
+        stats["backlog"] -= 1
+        if (time.perf_counter() - t0) - due <= slo:
+            stats["good"] += 1
+
+    hm = None
+    if adaptive:
+        hm = Helmsman(
+            load_census=lambda: dict(counts),
+            slo_alerts=lambda: (["goodput_burn"]
+                                if stats["backlog"] > 80 else []),
+            split=const.split,
+            merge=const.merge,
+            moved_bytes=lambda: const.rebalancer.moved_bytes_total,
+            reshard_busy=const.rebalancer.lock.locked,
+            hot_streak=2, cold_streak=3, hot_share=0.55, cold_share=0.15,
+            min_ops=15, cooldown=0.35, max_groups=4, budget_bytes=1 << 30,
+        )
+    stop = asyncio.Event()
+
+    async def sample():  # group-seconds you pay for, 20ms resolution
+        while not stop.is_set():
+            stats["integral"] += len(const.groups) * 0.02
+            await asyncio.sleep(0.02)
+
+    async def steer():
+        while not stop.is_set():
+            await hm.step()
+            await asyncio.sleep(0.1)
+
+    aux = [asyncio.ensure_future(sample())]
+    if hm is not None:
+        aux.append(asyncio.ensure_future(steer()))
+    await asyncio.gather(*(op(due, key) for due, key in sched))
+    stop.set()
+    await asyncio.gather(*aux)
+    history = list(hm.history) if hm else []
+    moved = const.rebalancer.moved_bytes_total
+    await const.stop()
+    group_s = max(stats["integral"], 1e-9)
+    return {
+        "good": stats["good"],
+        "group_s": round(group_s, 3),
+        "score": stats["good"] / group_s,
+        "splits": sum(1 for h in history if h["action"] == "split_done"),
+        "merges": sum(1 for h in history if h["action"] == "merge_done"),
+        "moved_bytes": moved,
+        "groups_final": len(const.groups),
+    }
+
+
+def main(argv=None) -> list:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--phase", type=float, default=1.0,
+                    help="seconds per hotspot phase (two phases)")
+    ap.add_argument("--tail", type=float, default=0.9,
+                    help="cool-tail seconds after the phases")
+    ap.add_argument("--rate", type=float, default=1600.0,
+                    help="open-loop arrivals/s during the phases")
+    ap.add_argument("--tail-rate", type=float, default=600.0,
+                    help="open-loop arrivals/s during the tail")
+    ap.add_argument("--p-hot", type=float, default=0.9,
+                    help="fraction of phase traffic on the hot key set")
+    ap.add_argument("--lanes", type=int, default=4,
+                    help="concurrent service lanes per group")
+    ap.add_argument("--service-ms", type=float, default=4.0,
+                    help="modeled service time per op")
+    ap.add_argument("--slo-ms", type=float, default=120.0,
+                    help="an op is GOOD iff done this soon after arrival")
+    ap.add_argument("--static-groups", type=int, default=2,
+                    help="S for the controller-off baseline fleet")
+    ap.add_argument("--jitter-ms", type=float, default=2.0,
+                    help="ChaosNet delivery jitter")
+    ap.add_argument("--seed", type=int, default=23)
+    args = ap.parse_args(argv)
+
+    sched, universe = _schedule(args)
+    static = asyncio.run(_drive(args, sched, universe, adaptive=False))
+    adaptive = asyncio.run(_drive(args, sched, universe, adaptive=True))
+
+    row = emit(
+        "autoscale goodput",
+        adaptive["score"],
+        "good/group-s",
+        adaptive["score"] / max(static["score"], 1e-9),
+        phase_s=args.phase,
+        tail_s=args.tail,
+        rate=args.rate,
+        slo_ms=args.slo_ms,
+        open_loop=True,
+        splits=adaptive["splits"],
+        merges=adaptive["merges"],
+        moved_bytes=adaptive["moved_bytes"],
+        adaptive_good=adaptive["good"],
+        adaptive_group_s=adaptive["group_s"],
+        static_good=static["good"],
+        static_group_s=static["group_s"],
+        static_groups=args.static_groups,
+        static_score=round(static["score"], 3),
+        groups_final=adaptive["groups_final"],
+    )
+    return [row]
+
+
+if __name__ == "__main__":
+    main()

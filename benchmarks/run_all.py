@@ -18,12 +18,12 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args(argv)
 
-    from benchmarks import (analytics_matvec, audit_cost, bft_sum, crossover,
-                            decrypt_throughput, encrypt_modexp,
-                            fleet_obs_overhead, mixed, multihost_load,
-                            overload_goodput, product, put_concurrency,
-                            resident_fold, search_latency, shard_scaling,
-                            sweep)
+    from benchmarks import (analytics_matvec, audit_cost, autoscale_goodput,
+                            bft_sum, crossover, decrypt_throughput,
+                            encrypt_modexp, fleet_obs_overhead, mixed,
+                            multihost_load, overload_goodput, product,
+                            put_concurrency, resident_fold, search_latency,
+                            shard_scaling, sweep)
 
     rows = []
     if args.quick:
@@ -55,6 +55,7 @@ def main(argv=None):
             ["--bits", "512", "--b", "48", "--repeats", "1"]
         )
         rows += search_latency.main(["--keys", "32", "--repeats", "2"])
+        rows += autoscale_goodput.main(["--phase", "0.8", "--tail", "0.6"])
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -72,6 +73,7 @@ def main(argv=None):
         rows += resident_fold.main([])
         rows += decrypt_throughput.main([])
         rows += search_latency.main([])
+        rows += autoscale_goodput.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

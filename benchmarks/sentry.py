@@ -12,11 +12,12 @@ Modes (all emit one JSON line to stdout):
         `multihost load` (benchmarks/multihost_load.py),
         `resident fold` (benchmarks/resident_fold.py),
         `fleet obs` (benchmarks/fleet_obs_overhead.py),
-        `decrypt throughput` (benchmarks/decrypt_throughput.py) and
-        `search latency` (benchmarks/search_latency.py) records
+        `decrypt throughput` (benchmarks/decrypt_throughput.py),
+        `search latency` (benchmarks/search_latency.py) and
+        `autoscale goodput` (benchmarks/autoscale_goodput.py) records
         in benchmarks/results.json / results_quick.json so a malformed
         scaling, analytics, overload, multihost, fleet-obs, resident,
-        decrypt or search record is caught by the same smoke.
+        decrypt, search or autoscale record is caught by the same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -364,6 +365,41 @@ def _check_decrypt_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_autoscale_records(root: str = REPO) -> dict:
+    """Validate `autoscale goodput` rows (benchmarks/autoscale_goodput
+    .py): positive good-per-group-second value and a detail block
+    carrying the static-baseline score (the comparison the record exists
+    for), non-negative split/merge/migrated-bytes counts (the controller
+    actions the score was bought with), and the open-loop flag. Same
+    malformed contract as the other row families: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("autoscale goodput")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("static_score"), (int, float))
+            and detail["static_score"] >= 0
+            and isinstance(detail.get("splits"), int)
+            and detail["splits"] >= 0
+            and isinstance(detail.get("merges"), int)
+            and detail["merges"] >= 0
+            and isinstance(detail.get("moved_bytes"), int)
+            and detail["moved_bytes"] >= 0
+            and detail.get("open_loop") is True
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed autoscale-goodput record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _load_fresh(path: str) -> dict:
     """A stats JSON: either the baseline schema or a bare kernels dict."""
     with open(path) as f:
@@ -411,6 +447,7 @@ def main(argv=None) -> int:
             resident = _check_resident_records()
             decrypt = _check_decrypt_records()
             search = _check_search_records()
+            autoscale = _check_autoscale_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -426,6 +463,7 @@ def main(argv=None) -> int:
             "resident_rows": resident["rows"],
             "decrypt_rows": decrypt["rows"],
             "search_rows": search["rows"],
+            "autoscale_rows": autoscale["rows"],
         }))
         return 0
 

@@ -319,8 +319,22 @@ class AdmissionController:
         """Register a transition observer: `fn(record)` fires on every
         shed/unshed transition (same dict shape as `transitions`
         entries). The event-driven hook the overload harnesses wait on
-        instead of sleeping and polling."""
+        instead of sleeping and polling. A NON-ZERO current level is
+        delivered immediately on subscription, so a late subscriber
+        (the Helmsman controller attaching mid-incident) sees the shed
+        it joined into instead of waiting for the next transition."""
         self._subscribers.append(fn)
+        if self.shed_level > 0:
+            try:
+                fn({
+                    "at": self._clock(), "from": self.shed_level,
+                    "to": self.shed_level, "direction": "shed",
+                    "reason": "subscribed mid-shed",
+                    "shedding": [CLASSES[i] for i in range(len(CLASSES))
+                                 if i >= len(CLASSES) - self.shed_level],
+                })
+            except Exception:  # observers must never wedge the ratchet
+                pass
 
     def unsubscribe(self, fn) -> None:
         try:

@@ -415,12 +415,16 @@ class ShardMapInstall:
     a live reshard. The map is HMAC-signed with the intranet secret and
     re-verified by the receiving agent, so the frame only has to be
     delivered, not trusted; `force` permits the abort path's epoch
-    rollback. Rides the authenticated transport like the Kill/Redeploy
-    control messages."""
+    rollback. `lease` > 0 installs the map provisionally for that many
+    seconds (shard/shardmap.ShardState fence lease): if the reshard
+    driver dies before committing, the group heals back to its last
+    committed map instead of staying fenced forever. Rides the
+    authenticated transport like the Kill/Redeploy control messages."""
 
     map: dict
     force: bool
     nonce: int
+    lease: float = 0.0
 
 
 @dataclass(frozen=True)

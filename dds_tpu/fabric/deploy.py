@@ -214,8 +214,22 @@ class MeridianController:
             manifest_timeout=self.sh.manifest_timeout,
             ack_timeout=self.sh.ack_timeout,
             chunk_keys=self.sh.migrate_chunk_keys,
+            fence_lease=self.sh.fence_lease,
+            journal_dir=self.sh.plan_dir or None,
             on_activate=self.broadcast_activation,
         )
+
+    @property
+    def phase(self):
+        return self.rebalancer.phase
+
+    def retry_after(self) -> float:
+        return self.rebalancer.retry_after()
+
+    async def recover(self) -> str | None:
+        """Resolve a plan a crashed controller left in the journal —
+        called once at proxy boot, before any new plan can start."""
+        return await self.rebalancer.recover(self.handle_for)
 
     def handle_for(self, gid: str) -> RemoteShardGroup:
         hostport = self.fab.groups.get(gid)
@@ -250,6 +264,43 @@ class MeridianController:
         return await self.rebalancer.split(
             self.handle_for(source), self.handle_for(target)
         )
+
+    async def merge(self, source: str) -> ShardMap:
+        """Fold `source`'s keyspace back into its ring successors and
+        retire it to standby (it stays launched and configured, so the
+        next split can reuse it)."""
+        smap = self.manager.current()
+        if source not in smap.groups:
+            raise ValueError(f"unknown source group {source!r}")
+        if len(smap.groups) < 2:
+            raise ValueError("cannot merge the last group away")
+        receivers = [self.handle_for(g) for g in smap.absorbers(source)]
+        return await self.rebalancer.merge(self.handle_for(source),
+                                           receivers)
+
+    async def promote(self, dead: str) -> ShardMap:
+        """Disaster takeover for a DEAD group process: relabel its ring
+        arcs — same positions, epoch+1 — onto a configured standby whose
+        process is alive, freeze-commit the takeover map on the standby,
+        activate, and broadcast. Availability over data: whole-group loss
+        is beyond the <= f fault model, so the slice restarts empty."""
+        from dds_tpu.obs.flight import flight
+
+        smap = self.manager.current()
+        if dead not in smap.groups:
+            raise ValueError(f"unknown group {dead!r}")
+        standby = self.pick_target(smap)
+        new_map = smap.relabel(dead, standby).sign(
+            self.cfg.security.abd_mac_secret.encode()
+        )
+        # the standby must hold the takeover map BEFORE routing reaches
+        # it (acked install, no lease: this is a commit, not a plan)
+        await self.handle_for(standby).state.install(new_map)
+        self.manager.activate(new_map)
+        await self.broadcast_activation(new_map)
+        await flight.record_async("takeover", dead=dead, standby=standby,
+                                  epoch=new_map.epoch)
+        return new_map
 
     async def broadcast_activation(self, smap: ShardMap) -> None:
         """Push the activated map to every configured group agent (the
@@ -341,6 +392,37 @@ def _attach_watchtower(cfg, *, check_quorum: bool, geometry: dict) -> None:
     watchtower.attach(_tracer)
 
 
+def _wire_helmsman(cfg, server, stoppables, *, load_census, breaker_census,
+                   split, merge, promote, rebalancer, source_ages=None):
+    """Attach the Helmsman autoscaler to a proxy-resident server when
+    [helmsman] is enabled: SLO/admission/breaker signals from the server,
+    load shares from the router, actions onto the reshard controller."""
+    if not cfg.helmsman.enabled:
+        return None
+    from dds_tpu.fleet import Helmsman
+
+    admission = server.admission
+    hm = Helmsman.from_config(
+        cfg.helmsman,
+        load_census=load_census,
+        slo_alerts=server.slo.alerts,
+        shed_level=(lambda a=admission: a.shed_level if a else 0),
+        breaker_census=breaker_census,
+        source_ages=source_ages,
+        split=split,
+        merge=merge,
+        promote=promote,
+        moved_bytes=lambda r=rebalancer: r.moved_bytes_total,
+        reshard_busy=lambda r=rebalancer: r.lock.locked(),
+    )
+    if admission is not None:
+        admission.subscribe(hm.on_admission)
+    server.helmsman = hm
+    hm.start()
+    stoppables.append(_Stopper(hm.stop))
+    return hm
+
+
 async def launch_meridian(cfg, net, stoppables, ssl_server, ssl_client):
     kind, gid = parse_role(cfg.fabric.role)
     if kind == "all":
@@ -369,6 +451,8 @@ async def _launch_all(cfg, net, stoppables, ssl_server, ssl_client):
         manifest_timeout=sh.manifest_timeout,
         ack_timeout=sh.ack_timeout,
         chunk_keys=sh.migrate_chunk_keys,
+        fence_lease=sh.fence_lease,
+        journal_dir=sh.plan_dir or None,
         namer=namer,
         n_active=sh.replicas_per_group,
         n_sentinent=sh.sentinent_per_group,
@@ -405,15 +489,9 @@ async def _launch_all(cfg, net, stoppables, ssl_server, ssl_client):
     # them through the rebalancer's on_activate hook
     hub = EpochGossipHub()
     const.rebalancer.on_activate = lambda smap: hub.notify()
-
-    async def reshard(source: str, target: str | None = None):
-        if target is not None:
-            raise ValueError(
-                "role 'all' builds its split target in-process; "
-                "omit 'target'"
-            )
-        await const.split(source)
-        return const.manager.current()
+    if sh.plan_dir:
+        await const.rebalancer.recover(const.group)
+    from dds_tpu.run import ConstellationReshard
 
     server = DDSRestServer(
         const.router,
@@ -424,9 +502,16 @@ async def _launch_all(cfg, net, stoppables, ssl_server, ssl_client):
         local_replicas=replicas,
         slo=SloEngine.from_obs(cfg.obs),
         gossip=hub,
-        reshard=reshard,
+        reshard=ConstellationReshard(const),
     )
     await server.start()
+    _wire_helmsman(cfg, server, stoppables,
+                   load_census=const.router.load_census,
+                   breaker_census=const.router.breaker_census,
+                   split=lambda gid, c=const: c.split(gid),
+                   merge=lambda gid, c=const: c.merge(gid),
+                   promote=lambda gid, c=const: c.promote(gid),
+                   rebalancer=const.rebalancer)
 
     _identify(cfg, namer, "all")
     dep = Deployment(cfg, net, replicas, None, server,
@@ -633,9 +718,15 @@ async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
     follower.start()
     stoppables.append(_Stopper(follower.stop))
 
-    rpc = AgentClient(net, namer("meridian-ctl"), timeout=fab.rpc_timeout)
+    rpc = AgentClient(net, namer("meridian-ctl"), timeout=fab.rpc_timeout,
+                      budget=fab.rpc_budget or None)
     stoppables.append(_Stopper(rpc.stop))
     controller = MeridianController(cfg, net, namer, manager, rpc)
+    if sh.plan_dir:
+        # a crashed predecessor may have left a plan mid-flight: resolve
+        # it (roll back before commit, forward after) before any traffic
+        # or new plan touches the fleet
+        await controller.recover()
 
     sup0 = next(iter(clients.values())).cfg.supervisor
     server = DDSRestServer(
@@ -647,10 +738,21 @@ async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
         local_replicas={},
         slo=slo_engine,
         gossip=hub,
-        reshard=controller.split,
+        reshard=controller,
         fleet=collector,
     )
     await server.start()
+    _wire_helmsman(
+        cfg, server, stoppables,
+        load_census=router.load_census,
+        breaker_census=router.breaker_census,
+        split=lambda gid, c=controller: c.split(gid),
+        merge=lambda gid, c=controller: c.merge(gid),
+        promote=lambda gid, c=controller: c.promote(gid),
+        rebalancer=controller.rebalancer,
+        source_ages=(collector.source_ages if collector is not None
+                     else None),
+    )
 
     _identify(cfg, namer, "proxy")
     dep = Deployment(cfg, net, {}, None, server, None, ssl_client,

@@ -33,6 +33,7 @@ import logging
 from dds_tpu.core import messages as M
 from dds_tpu.shard.shardmap import ShardMap
 from dds_tpu.utils import sigs
+from dds_tpu.utils.retry import Deadline, RetryPolicy, retry_deadline
 
 log = logging.getLogger("dds.fabric.remote")
 
@@ -61,7 +62,8 @@ class MeridianAgent:
         if isinstance(msg, M.ShardMapInstall):
             try:
                 smap = ShardMap.from_wire(msg.map)
-                self.group.state.install(smap, force=msg.force)
+                self.group.state.install(smap, force=msg.force,
+                                         lease=getattr(msg, "lease", 0.0))
             except (ValueError, KeyError, TypeError) as e:
                 log.warning("refused shard-map install from %s: %s",
                             sender, e)
@@ -72,9 +74,10 @@ class MeridianAgent:
             try:
                 smap = ShardMap.from_wire(msg.map)
                 self.view.install(smap)          # verifies + notifies hub
-                # fencing follows the active map epoch-forward; during a
-                # split the participants already hold it from the freeze
-                if smap.epoch > self.group.state.epoch:
+                # fencing follows the active map epoch-forward; >= so an
+                # activation also COMMITS the equal-epoch map the freeze
+                # installed under a fence lease
+                if smap.epoch >= self.group.state.epoch:
                     self.group.state.install(smap)
             except (ValueError, KeyError, TypeError) as e:
                 log.warning("refused shard-map activate from %s: %s",
@@ -91,18 +94,37 @@ class MeridianAgent:
 
 
 class AgentError(RuntimeError):
-    """An agent refused an RPC (bad map, backwards epoch) or timed out —
-    the rebalancer's generic failure path aborts the split safely."""
+    """An agent refused an RPC (bad map, backwards epoch) — definitive,
+    never retried. The rebalancer's generic failure path aborts the plan
+    safely."""
+
+
+class AgentTimeout(AgentError):
+    """An agent did not answer within one attempt's timeout — the only
+    retryable agent failure. `AgentClient._call` retries these under the
+    call's `Deadline` budget; when the budget runs out the typed
+    `DeadlineExceededError` propagates and the rebalancer maps it to a
+    plan ABORT instead of hanging mid-reshard."""
 
 
 class AgentClient:
     """Controller-side RPC endpoint: correlates nonced requests to agent
-    replies with a timeout. One instance serves every remote group."""
+    replies. One instance serves every remote group.
 
-    def __init__(self, net, addr: str, timeout: float = 5.0):
+    Every control RPC runs under a `utils/retry.Deadline`: `timeout` is
+    the per-ATTEMPT wait, `budget` the total time a call may spend across
+    attempts (jittered exponential backoff between them). Lost frames and
+    a briefly-restarting agent are retried away; a refusal (signed-map
+    verification, backwards epoch) is definitive and never retried."""
+
+    def __init__(self, net, addr: str, timeout: float = 5.0,
+                 budget: float | None = None):
         self.net = net
         self.addr = addr
         self.timeout = timeout
+        # default: room for ~3 full attempts plus backoff
+        self.budget = budget if budget is not None else 3.5 * timeout
+        self.policy = RetryPolicy(base=0.05, multiplier=2.0, max_delay=1.0)
         self._pending: dict[int, asyncio.Future] = {}
         net.register(addr, self.handle)
 
@@ -115,23 +137,42 @@ class AgentClient:
         if fut is not None and not fut.done():
             fut.set_result(msg)
 
-    async def _call(self, agent: str, make_msg, *, timeout: float | None = None):
+    async def _call_once(self, agent: str, make_msg, timeout: float):
         nonce = sigs.generate_nonce()
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[nonce] = fut
         try:
             self.net.send(self.addr, agent, make_msg(nonce))
-            return await asyncio.wait_for(fut, timeout or self.timeout)
+            return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
-            raise AgentError(f"agent {agent} did not answer")
+            raise AgentTimeout(f"agent {agent} did not answer")
         finally:
             self._pending.pop(nonce, None)
 
+    async def _call(self, agent: str, make_msg, *,
+                    timeout: float | None = None,
+                    deadline: Deadline | None = None):
+        per_attempt = timeout or self.timeout
+        deadline = deadline or Deadline(max(self.budget, per_attempt))
+
+        async def attempt():
+            t = deadline.timeout(per_attempt)
+            if t <= 0:
+                raise AgentTimeout(f"agent {agent}: no budget left")
+            return await self._call_once(agent, make_msg, t)
+
+        # only AgentTimeout retries; refusals propagate immediately. A
+        # spent deadline surfaces as DeadlineExceededError -> plan abort.
+        return await retry_deadline(attempt, deadline, self.policy,
+                                    retry_on=(AgentTimeout,))
+
     async def install(self, agent: str, smap: ShardMap,
-                      force: bool = False) -> None:
+                      force: bool = False, lease: float = 0.0,
+                      deadline: Deadline | None = None) -> None:
         wire = smap.to_wire()
         reply = await self._call(
-            agent, lambda n: M.ShardMapInstall(wire, force, n)
+            agent, lambda n: M.ShardMapInstall(wire, force, n, lease),
+            deadline=deadline,
         )
         if not isinstance(reply, M.ShardMapAck) or not reply.ok:
             raise AgentError(
@@ -139,9 +180,11 @@ class AgentClient:
                 f"{getattr(reply, 'error', 'bad reply')!r}"
             )
 
-    async def activate(self, agent: str, smap: ShardMap) -> None:
+    async def activate(self, agent: str, smap: ShardMap,
+                       deadline: Deadline | None = None) -> None:
         wire = smap.to_wire()
-        reply = await self._call(agent, lambda n: M.ShardMapActivate(wire, n))
+        reply = await self._call(agent, lambda n: M.ShardMapActivate(wire, n),
+                                 deadline=deadline)
         if not isinstance(reply, M.ShardMapAck) or not reply.ok:
             raise AgentError(
                 f"agent {agent} refused map activate: "
@@ -149,17 +192,23 @@ class AgentClient:
             )
 
     async def export(self, agent: str, endpoint: str,
-                     timeout: float | None = None) -> dict:
+                     timeout: float | None = None,
+                     deadline: Deadline | None = None) -> dict:
         reply = await self._call(
             agent, lambda n: M.ShardExportRequest(endpoint, n),
             timeout=timeout,
+            deadline=deadline or Deadline(
+                max(self.budget, timeout or self.timeout)
+            ),
         )
         if not isinstance(reply, M.ShardExport):
             raise AgentError(f"agent {agent} sent a bad export reply")
         return dict(reply.entries)
 
-    async def prune(self, agent: str) -> int:
-        reply = await self._call(agent, lambda n: M.ShardPruneRequest(n))
+    async def prune(self, agent: str,
+                    deadline: Deadline | None = None) -> int:
+        reply = await self._call(agent, lambda n: M.ShardPruneRequest(n),
+                                 deadline=deadline)
         if not isinstance(reply, M.ShardPruned):
             raise AgentError(f"agent {agent} sent a bad prune reply")
         return int(reply.dropped)
@@ -174,8 +223,9 @@ class _RemoteGroupState:
         self._rpc = rpc
         self._agent = agent
 
-    def install(self, smap: ShardMap, force: bool = False):
-        return self._rpc.install(self._agent, smap, force=force)
+    def install(self, smap: ShardMap, force: bool = False,
+                lease: float = 0.0):
+        return self._rpc.install(self._agent, smap, force=force, lease=lease)
 
 
 class RemoteShardGroup:

@@ -1,0 +1,5 @@
+"""Fleet-level control loops: the planes that steer the whole
+constellation rather than one group — currently the Helmsman autoscaler
+(fleet/helmsman.py)."""
+
+from dds_tpu.fleet.helmsman import Helmsman  # noqa: F401

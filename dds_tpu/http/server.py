@@ -92,7 +92,7 @@ _RETRYABLE = (ByzantineError, WrongShardError, asyncio.TimeoutError,
 # reshard control route) bypass the Bulwark gate entirely and keep
 # answering through a full shed.
 _ADMISSION_EXEMPT = frozenset({"health", "metrics", "slo", "shards",
-                               "fleet", "_trace", "_reshard"})
+                               "fleet", "_trace", "_reshard", "_helmsman"})
 
 
 @dataclass
@@ -242,18 +242,26 @@ class DDSRestServer:
     def __init__(self, abd: AbdClient, config: ProxyConfig | None = None,
                  local_replicas: dict | None = None,
                  slo: SloEngine | None = None,
-                 gossip=None, reshard=None, fleet=None):
+                 gossip=None, reshard=None, fleet=None, helmsman=None):
         self.abd = abd
         self.cfg = config or ProxyConfig()
         # Meridian wiring: `gossip` is an EpochGossipHub parked /shards
         # long-polls sleep on (None = conditional GETs answer immediately);
-        # `reshard` is the fabric controller's async split(source, target)
-        # hook behind POST /_reshard (gated by reshard_route_enabled);
-        # `fleet` is the Panopticon FleetCollector serving GET /fleet/*
-        # (None everywhere but a fleet-enabled proxy role — the routes 404)
+        # `reshard` is the reshard controller behind POST /_reshard (gated
+        # by reshard_route_enabled) — either an object with async
+        # `split(source, target)` / `merge(source)` plus `retry_after()`
+        # and `phase`, or a bare legacy split callable; `fleet` is the
+        # Panopticon FleetCollector serving GET /fleet/* (None everywhere
+        # but a fleet-enabled proxy role — the routes 404); `helmsman` is
+        # the fleet autoscaler (report in /health, pin via /_helmsman)
         self._gossip = gossip
         self._reshard = reshard
         self._fleet = fleet
+        self.helmsman = helmsman
+        # one plan at a time: the in-flight (action, source, target) and
+        # its task — identical repeats attach to it (idempotent), any
+        # other reshape answers 409 + a phase-derived Retry-After
+        self._reshard_inflight: dict | None = None
         # per-route SLO accounting (obs/slo): every request is classified
         # good/bad in handle(); run.launch passes an engine built from the
         # [obs] config, tests get the defaults
@@ -1508,6 +1516,10 @@ class DDSRestServer:
                     # Spyglass surface: per-group indexed keys/packs and
                     # the pending ingest queue
                     health["search"] = self._search.stats()
+                if self.helmsman is not None:
+                    # Helmsman surface: pin state, budget, streaks, and
+                    # the recent decision history
+                    health["helmsman"] = self.helmsman.report()
                 recovery = self._recovery_status()
                 if recovery is not None:
                     health["recovery"] = recovery
@@ -1543,27 +1555,30 @@ class DDSRestServer:
             case ("POST", "_reshard") if (
                 self.cfg.reshard_route_enabled and self._reshard is not None
             ):
-                # operator control: drive a live cross-host split through
-                # the fabric controller. Body {"source": gid[, "target":
-                # gid]}; answers the activated epoch, or 409 when the
-                # split aborted safely (old map back in force).
-                body = req.json() or {}
-                source = body.get("source")
-                if not isinstance(source, str) or not source:
-                    return Response.text("missing source group", 400)
-                target = body.get("target")
-                from dds_tpu.shard.rebalance import ReshardAborted
+                # operator control: drive a live split or merge through
+                # the reshard controller. Body {"source": gid[, "target":
+                # gid][, "action": "split"|"merge"]}; answers the
+                # activated epoch, 409 {"aborted"} when the plan aborted
+                # safely (old map back in force), or 409 {"busy"} + a
+                # phase-derived Retry-After while a DIFFERENT plan holds
+                # the controller. Repeating an identical request is
+                # idempotent: in flight it attaches to the running plan;
+                # completed it answers the current map.
+                return await self._reshard_route(req)
 
-                try:
-                    smap = await self._reshard(source, target)
-                except ReshardAborted as e:
-                    return Response.json(
-                        {"aborted": str(e),
-                         "epoch": self._shards.epoch}, status=409,
-                    )
-                return Response.json(
-                    {"epoch": smap.epoch, "groups": list(smap.groups)}
-                )
+            case ("POST", "_helmsman") if (
+                self.cfg.reshard_route_enabled and self.helmsman is not None
+            ):
+                # manual override: {"pin": true} freezes the fleet shape
+                # (autoscaling halts, dead-group promotion keeps running),
+                # {"pin": false} resumes. Answers the controller report.
+                body = req.json() or {}
+                pin = body.get("pin")
+                if not isinstance(pin, bool):
+                    return Response.text("body must set pin: true|false",
+                                         400)
+                (self.helmsman.pin if pin else self.helmsman.unpin)()
+                return Response.json(self.helmsman.report())
 
             case ("GET", "slo") if self.cfg.slo_route_enabled:
                 # per-route objective/burn state (obs/slo) plus the
@@ -1622,6 +1637,95 @@ class DDSRestServer:
                 )
 
         return Response(404)
+
+    async def _reshard_route(self, req: Request) -> Response:
+        import asyncio as _aio
+
+        from dds_tpu.shard.rebalance import ReshardAborted
+        from dds_tpu.utils.tasks import supervised_task
+
+        body = req.json() or {}
+        action = body.get("action", "split")
+        if action not in ("split", "merge"):
+            return Response.text("action must be split or merge", 400)
+        source = body.get("source")
+        if not isinstance(source, str) or not source:
+            return Response.text("missing source group", 400)
+        target = body.get("target")
+        ctl = self._reshard
+        split_fn = getattr(ctl, "split", ctl)
+        merge_fn = getattr(ctl, "merge", None)
+        if action == "merge" and merge_fn is None:
+            return Response.text("merge is not supported by this "
+                                 "controller", 400)
+
+        smap = self._shards.current()
+        # COMPLETED idempotency: the shape this request asks for already
+        # holds, so answer the current map instead of failing the replay
+        done = (
+            (action == "split" and isinstance(target, str)
+             and target in smap.groups and source in smap.groups)
+            or (action == "merge" and source not in smap.groups)
+        )
+        if done and self._reshard_inflight is None:
+            return Response.json({"epoch": smap.epoch,
+                                  "groups": list(smap.groups),
+                                  "idempotent": True})
+
+        key = (action, source, target)
+        inflight = self._reshard_inflight
+        if inflight is not None and inflight["key"] != key:
+            # a DIFFERENT plan holds the controller: refuse honestly,
+            # with a Retry-After derived from its phase
+            ra = getattr(ctl, "retry_after", None)
+            retry = float(ra()) if callable(ra) else 5.0
+            resp = Response.json(
+                {"busy": {"action": inflight["key"][0],
+                          "source": inflight["key"][1],
+                          "target": inflight["key"][2]},
+                 "phase": getattr(ctl, "phase", None)}, status=409,
+            )
+            resp.headers["Retry-After"] = str(max(1, int(retry + 0.5)))
+            return resp
+        if inflight is not None:
+            task = inflight["task"]  # identical repeat: attach, no new plan
+        else:
+            async def run():
+                # exceptions become results so an attached repeat sees
+                # the same outcome instead of racing exception retrieval
+                try:
+                    if action == "merge":
+                        return "ok", await merge_fn(source)
+                    return "ok", await split_fn(source, target)
+                except ReshardAborted as e:
+                    return "aborted", str(e)
+                except ValueError as e:
+                    # operator error (unknown group, taken target): the
+                    # request is wrong, not the fleet
+                    return "invalid", str(e)
+
+            task = supervised_task(run(), name=f"reshard-{action}-{source}")
+            rec = {"key": key, "task": task}
+            self._reshard_inflight = rec
+            task.add_done_callback(
+                lambda _t, rec=rec: (
+                    setattr(self, "_reshard_inflight", None)
+                    if self._reshard_inflight is rec else None
+                )
+            )
+        # shield: an impatient client disconnecting must not cancel a
+        # half-streamed migration
+        status, result = await _aio.shield(task)
+        if status == "invalid":
+            return Response.text(result, 400)
+        if status == "aborted":
+            return Response.json(
+                {"aborted": result, "epoch": self._shards.epoch}, status=409,
+            )
+        new_map = result if hasattr(result, "epoch") else self._shards.current()
+        return Response.json(
+            {"epoch": new_map.epoch, "groups": list(new_map.groups)}
+        )
 
     async def _shards_route(self, req: Request) -> Response:
         """GET /shards with conditional-get + long-poll gossip semantics."""

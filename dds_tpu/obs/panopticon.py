@@ -676,6 +676,24 @@ class FleetCollector:
             })
         return rows
 
+    def source_ages(self) -> dict[str, float]:
+        """Shard gid -> seconds since that group process's last shipped
+        batch. The span shipper beats ~1/s even when idle, so an age of
+        tens of seconds means the PROCESS is gone, not merely quiet —
+        the Helmsman controller's dead-group takeover signal. Sources
+        without a shard label (proxies, observers) are skipped; when two
+        sources claim one shard the freshest wins."""
+        now = time.monotonic()
+        out: dict[str, float] = {}
+        for src in self._sources.values():
+            gid = src.get("shard") or ""
+            if not gid:
+                continue
+            age = now - src["mono"]
+            if gid not in out or age < out[gid]:
+                out[gid] = age
+        return out
+
     def fleet_metrics(self) -> str:
         """The `GET /fleet/metrics` body: every source's exposition merged
         into one valid document, samples labeled by origin, plus

@@ -234,6 +234,26 @@ class SloEngine:
                 out.append(route)
         return out
 
+    def burns(self) -> dict[str, list[float]]:
+        """Route -> [burn per window, fast first] — the compact snapshot
+        the Helmsman controller flight-records with each decision, so an
+        autoscale action is auditable against the burn that drove it
+        (alerts() says WHETHER a route pages; this says how hard)."""
+        with self._lock:
+            items = [(r, list(b)) for r, b in self._bins.items()]
+        out: dict[str, list[float]] = {}
+        for route, bins in items:
+            slo = self.slo_for(route)
+            budget = max(1e-9, 1.0 - slo.objective)
+            row = []
+            for w in self.windows:
+                good, bad_lat, bad_err = self._window_counts(bins, w)
+                total = good + bad_lat + bad_err
+                bad = bad_lat + bad_err
+                row.append(round((bad / total) / budget if total else 0.0, 3))
+            out[route] = row
+        return out
+
     def export_gauges(self, registry) -> None:
         """Mirror the report as scrape-time gauges (http/server calls this
         from `_sample_state_gauges`)."""

@@ -567,11 +567,43 @@ def proxy_config(cfg: DDSConfig, supervisor, ssl_server, ssl_client,
         admission=cfg.admission,
         resident=cfg.resident,
         search=cfg.search,
+        # operator reshape control (POST /_reshard, /_helmsman) — gated
+        # exactly like the Meridian proxy role; without a reshard
+        # controller wired the routes still 404
+        reshard_route_enabled=cfg.fabric.admin_routes,
         ssl_server_context=ssl_server,
         ssl_client_context=ssl_client,
     )
     kw.update(overrides)
     return ProxyConfig(**kw)
+
+
+class ConstellationReshard:
+    """POST /_reshard controller for the in-process constellation: the
+    same surface the Meridian controller presents (async split/merge +
+    phase/retry_after for the route's 409 handling), delegating to the
+    Constellation. An omitted split target lets the Constellation name
+    the new group; naming one makes the request replayable (the route's
+    completed-idempotency check needs the target to recognize a done
+    split)."""
+
+    def __init__(self, const):
+        self._const = const
+
+    @property
+    def phase(self):
+        return self._const.rebalancer.phase
+
+    def retry_after(self) -> float:
+        return self._const.rebalancer.retry_after()
+
+    async def split(self, source: str, target: str | None = None):
+        await self._const.split(source, target)
+        return self._const.manager.current()
+
+    async def merge(self, source: str):
+        await self._const.merge(source)
+        return self._const.manager.current()
 
 
 async def _launch_constellation(cfg: DDSConfig, net, stoppables,
@@ -596,6 +628,8 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
         manifest_timeout=sh.manifest_timeout,
         ack_timeout=sh.ack_timeout,
         chunk_keys=sh.migrate_chunk_keys,
+        fence_lease=sh.fence_lease,
+        journal_dir=sh.plan_dir or None,
         n_active=sh.replicas_per_group,
         n_sentinent=sh.sentinent_per_group,
         quorum=sh.quorum_size,
@@ -605,6 +639,11 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
         abd_cfg=abd_cfg,
         chaos=cfg.attacks.chaos_enabled,
     )
+    if sh.plan_dir:
+        # a previous process may have died mid-reshard: resolve the
+        # journaled plan (roll back before commit, forward after) before
+        # any traffic or new plan touches the fleet
+        await const.rebalancer.recover(const.group)
     replicas: dict[str, BFTABDNode] = {}
     for g in const.groups:
         replicas.update(g.replicas)
@@ -626,8 +665,31 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
                      ssl_server, ssl_client),
         local_replicas=replicas,
         slo=SloEngine.from_obs(cfg.obs),
+        reshard=ConstellationReshard(const),
     )
     await server.start()
+
+    if cfg.helmsman.enabled:
+        from dds_tpu.fleet import Helmsman
+
+        admission = server.admission
+        hm = Helmsman.from_config(
+            cfg.helmsman,
+            load_census=const.router.load_census,
+            slo_alerts=server.slo.alerts,
+            shed_level=(lambda a=admission: a.shed_level if a else 0),
+            breaker_census=const.router.breaker_census,
+            split=(lambda gid, c=const: c.split(gid)),
+            merge=(lambda gid, c=const: c.merge(gid)),
+            promote=(lambda gid, c=const: c.promote(gid)),
+            moved_bytes=lambda r=const.rebalancer: r.moved_bytes_total,
+            reshard_busy=lambda r=const.rebalancer: r.lock.locked(),
+        )
+        if admission is not None:
+            admission.subscribe(hm.on_admission)
+        server.helmsman = hm
+        hm.start()
+        stoppables.append(hm)
 
     dep = Deployment(cfg, net, replicas, None, server,
                      const.groups[0].trudy, ssl_client, stoppables,

@@ -68,6 +68,9 @@ class Constellation:
     net: object = None
     secret: bytes = b""
     _build_kwargs: dict = field(default_factory=dict)
+    # warm standbys: groups a merge retired (still running, pruned empty)
+    # — the next split or takeover reuses one instead of building fresh
+    standbys: list = field(default_factory=list)
 
     @property
     def gids(self) -> list[str]:
@@ -76,28 +79,107 @@ class Constellation:
         return [g.gid for g in self.groups]
 
     def group(self, gid: str) -> ShardGroup:
-        return next(g for g in self.groups if g.gid == gid)
+        for g in self.groups:
+            if g.gid == gid:
+                return g
+        raise ValueError(f"unknown group {gid!r}")
 
-    async def split(self, victim_gid: str) -> ShardGroup:
-        """Live split: bring up a fresh group, migrate ~half of the
-        victim's keyspace into it (Aegis-verified, epoch-fenced), activate.
-        The new group fences everything until activation, so it can be
-        built eagerly without receiving traffic."""
-        new_gid = f"s{len(self.groups)}"
-        old_map = self.manager.current()
-        state = ShardState(new_gid, old_map, self.secret)
-        group = build_group(self.net, new_gid, state, **self._build_kwargs)
-        victim = self.group(victim_gid)
-        await self.rebalancer.split(victim, group)
+    def _fresh_gid(self) -> str:
+        used = {g.gid for g in self.groups} | {g.gid for g in self.standbys}
+        n = len(used)
+        while f"s{n}" in used:
+            n += 1
+        return f"s{n}"
+
+    def _acquire_standby(self, gid: str | None = None) -> ShardGroup:
+        """A serving-capable group outside the active map: a warm standby
+        a merge retired, else a freshly built one (fenced until a map
+        gives it keys, so it can be brought up eagerly without traffic).
+        A caller naming `gid` (an operator's replayable split target)
+        gets that standby, or a fresh group under that name."""
+        if gid is not None:
+            for i, g in enumerate(self.standbys):
+                if g.gid == gid:
+                    return self.standbys.pop(i)
+            if gid in {g.gid for g in self.groups}:
+                raise ValueError(f"target group {gid!r} is already active")
+        else:
+            if self.standbys:
+                return self.standbys.pop(0)
+            gid = self._fresh_gid()
+        state = ShardState(gid, self.manager.current(), self.secret)
+        return build_group(self.net, gid, state, **self._build_kwargs)
+
+    def _adopt(self, group: ShardGroup) -> None:
         self.groups.append(group)
-        self.router.clients[new_gid] = group.client
+        self.router.clients[group.gid] = group.client
         group.client.shard_epoch = lambda m=self.manager: m.current().epoch
         if not group.client.cfg.shard:
-            group.client.cfg.shard = new_gid
+            group.client.cfg.shard = group.gid
+
+    async def split(self, victim_gid: str,
+                    target_gid: str | None = None) -> ShardGroup:
+        """Live split: bring up a group (warm standby preferred; an
+        explicit `target_gid` makes the operation replayable by name),
+        migrate ~half of the victim's keyspace into it (Aegis-verified,
+        epoch-fenced), activate."""
+        group = self._acquire_standby(target_gid)
+        victim = self.group(victim_gid)
+        try:
+            await self.rebalancer.split(victim, group)
+        except BaseException:
+            # an aborted plan rolled the map back: the group is still a
+            # serving-capable standby — keep it warm instead of leaking it
+            self.standbys.append(group)
+            raise
+        self._adopt(group)
         return group
 
-    async def stop(self) -> None:
+    async def merge(self, victim_gid: str) -> list[str]:
+        """Live merge: fold `victim_gid`'s keyspace back into its ring
+        successors (same freeze/attest/stream/activate machinery as
+        split, run in reverse). The retired group keeps running as a
+        warm standby for the next split. Returns the receiver gids."""
+        old_map = self.manager.current()
+        receivers = [self.group(g) for g in old_map.absorbers(victim_gid)]
+        victim = self.group(victim_gid)
+        await self.rebalancer.merge(victim, receivers)
+        self.groups.remove(victim)
+        self.router.clients.pop(victim_gid, None)
+        self.standbys.append(victim)
+        return [r.gid for r in receivers]
+
+    async def promote(self, dead_gid: str) -> ShardGroup:
+        """Disaster takeover: `dead_gid`'s process is gone (no replica
+        answers), so its slice of the keyspace is relabeled — same ring
+        positions, epoch+1 — onto a standby group, which starts serving
+        it immediately. Availability over data: a whole-group loss is
+        beyond the <= f fault model, so the slice restarts empty and
+        refills from client writes (and the Lodestone resident plane,
+        where enabled). Announced like any activation (on_activate ->
+        gossip), so followers and routers converge on the takeover map."""
+        from dds_tpu.obs.flight import flight
+        from dds_tpu.shard.rebalance import _maybe_await
+
+        dead = self.group(dead_gid)
+        standby = self._acquire_standby()
+        new_map = (self.manager.current()
+                   .relabel(dead_gid, standby.gid).sign(self.secret))
+        self.groups.remove(dead)
+        self.router.clients.pop(dead_gid, None)
         for g in self.groups:
+            g.state.install(new_map)
+        standby.state.install(new_map)
+        self.manager.activate(new_map)
+        self._adopt(standby)
+        if self.rebalancer.on_activate is not None:
+            await _maybe_await(self.rebalancer.on_activate(new_map))
+        await flight.record_async("takeover", dead=dead_gid,
+                                  standby=standby.gid, epoch=new_map.epoch)
+        return standby
+
+    async def stop(self) -> None:
+        for g in self.groups + self.standbys:
             await g.stop()
 
 
@@ -174,6 +256,8 @@ def build_constellation(
     ack_timeout: float = 5.0,
     chunk_keys: int = 256,
     prune: bool = True,
+    fence_lease: float = 0.0,
+    journal_dir: str | None = None,
     seed: int | None = None,
     namer=None,
     **group_kwargs,
@@ -195,6 +279,7 @@ def build_constellation(
         addr=(namer or (lambda n: n))("rebalancer"),
         manifest_timeout=manifest_timeout,
         ack_timeout=ack_timeout, chunk_keys=chunk_keys, prune=prune,
+        fence_lease=fence_lease, journal_dir=journal_dir,
     )
     return Constellation(manager, router, groups, rebalancer, net=net,
                          secret=secret,

@@ -74,6 +74,10 @@ class ShardRouter:
         self.clients = clients
         self.replicas = _MergedTrust(clients)
         self._refresh = refresh
+        # cumulative routed-op count per group id — the Helmsman
+        # controller diffs successive snapshots to see per-group load
+        # share (hot/cold), so the counters never reset here
+        self._op_counts: dict[str, int] = {}
         for gid, c in clients.items():
             # every delegated message carries the ACTIVE map's epoch —
             # late-bound so an activation mid-request stamps correctly
@@ -121,8 +125,19 @@ class ShardRouter:
 
     # ----------------------------------------------------------- point ops
 
+    def _charge(self, gid: str, n: int = 1) -> None:
+        self._op_counts[gid] = self._op_counts.get(gid, 0) + n
+
+    def load_census(self) -> dict[str, int]:
+        """Cumulative routed ops per group, with every CURRENT group
+        present (zero-filled) so a cold group is visibly cold."""
+        out = {gid: 0 for gid in self.clients}
+        out.update(self._op_counts)
+        return out
+
     async def _point(self, op: str, key: str, call):
         gid, client = self._route(key)
+        self._charge(gid)
         t0 = time.perf_counter()
         try:
             return await call(client)
@@ -198,6 +213,7 @@ class ShardRouter:
             client = self.clients.get(gid)
             if client is None:
                 raise WrongShardError(keys[idxs[0]], sent_epoch=smap.epoch)
+            self._charge(gid, len(idxs))
             sub_keys = [keys[i] for i in idxs]
             sub_cached = None
             sub_fp = None

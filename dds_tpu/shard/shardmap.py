@@ -34,9 +34,12 @@ from __future__ import annotations
 import bisect
 import dataclasses
 import hashlib
+import logging
 from dataclasses import dataclass
 
 from dds_tpu.utils import sigs
+
+log = logging.getLogger("dds.shard.map")
 
 _RING = 1 << 64  # ring positions are the first 8 bytes of sha256
 
@@ -103,6 +106,61 @@ class ShardMap:
         return ShardMap(self.epoch + 1, vnodes,
                         tuple(sorted(self.groups + (new_gid,))))
 
+    def merge(self, victim: str) -> "ShardMap":
+        """Epoch+1 map with `victim`'s vnodes RETIRED: every key the
+        victim owned falls to the first surviving vnode clockwise of its
+        position. The exact inverse of `split` — `m.split(v, g).merge(g)`
+        owns every key identically to `m` (epoch aside) — and merge-local
+        the same way split is split-local: only keys the victim owned
+        move; every other group's ownership is bit-identical across the
+        epoch bump. Unsigned — callers sign before distributing."""
+        if victim not in self.groups:
+            raise ValueError(f"unknown victim group {victim!r}")
+        if len(self.groups) < 2:
+            raise ValueError("cannot merge the last group away")
+        vnodes = tuple((p, g) for p, g in self.vnodes if g != victim)
+        groups = tuple(g for g in self.groups if g != victim)
+        return ShardMap(self.epoch + 1, vnodes, groups)
+
+    def relabel(self, old_gid: str, new_gid: str) -> "ShardMap":
+        """Epoch+1 map where `new_gid` takes over `old_gid`'s ring
+        positions VERBATIM — the disaster-takeover move when a whole
+        group process dies: ownership arcs are bit-identical, only the
+        serving group changes, so no key moves between surviving groups.
+        Unsigned — callers sign before distributing."""
+        if old_gid not in self.groups:
+            raise ValueError(f"unknown group {old_gid!r}")
+        if new_gid in self.groups:
+            raise ValueError(f"group {new_gid!r} already in the map")
+        vnodes = tuple(
+            (p, new_gid if g == old_gid else g) for p, g in self.vnodes
+        )
+        groups = tuple(sorted(
+            new_gid if g == old_gid else g for g in self.groups
+        ))
+        return ShardMap(self.epoch + 1, vnodes, groups)
+
+    def absorbers(self, victim: str) -> list[str]:
+        """Groups that would receive keys if `victim` merged away: for
+        each victim vnode, the owner of the first surviving vnode
+        clockwise (the group absorbing that arc). Construction order is
+        ring order, deduplicated — deterministic for a given map, so the
+        rebalancer and any observer derive the same receiver set."""
+        if victim not in self.groups:
+            raise ValueError(f"unknown victim group {victim!r}")
+        out: list[str] = []
+        n = len(self.vnodes)
+        for i, (_, gid) in enumerate(self.vnodes):
+            if gid != victim:
+                continue
+            for j in range(1, n):
+                succ = self.vnodes[(i + j) % n][1]
+                if succ != victim:
+                    if succ not in out:
+                        out.append(succ)
+                    break
+        return out
+
     # ------------------------------------------------------------- routing
 
     @staticmethod
@@ -161,36 +219,96 @@ class ShardState:
     newest verified map the group has been handed. Every replica of a
     group shares ONE instance (installed in a single step per group —
     the in-process analogue of a config push), so `owns()` answers the
-    fence question consistently across the group."""
+    fence question consistently across the group.
 
-    def __init__(self, group_id: str, smap: ShardMap, secret: bytes):
+    **Fence lease**: a reshard's freeze step installs the new map with a
+    TTL (`lease` seconds). If the plan's driver dies before committing
+    (activation or rollback), the lease expires and the state reverts to
+    the last COMMITTED map on its own — a crashed controller can stall a
+    group for one TTL, never fence it forever. The rebalancer renews the
+    lease while it streams and commits it (re-install, no lease) right
+    after activation or abort."""
+
+    def __init__(self, group_id: str, smap: ShardMap, secret: bytes,
+                 clock=None):
+        import time as _time
+
         self.group_id = group_id
         self.secret = secret
+        self._clock = clock or _time.monotonic
         self._map = None
+        self._lease_at = 0.0        # monotonic expiry; 0 = committed
+        self._fallback = None       # last committed map, restored on expiry
         self.install(smap)
+
+    def _lease_check(self) -> None:
+        if self._fallback is not None and self._clock() >= self._lease_at:
+            # the driver never came back: heal to the committed map
+            expired, self._map = self._map, self._fallback
+            self._fallback, self._lease_at = None, 0.0
+            from dds_tpu.obs.metrics import metrics
+
+            metrics.inc("dds_shard_lease_expired_total",
+                        shard=self.group_id,
+                        help="fence leases that expired back to the "
+                             "committed map (crashed reshard driver)")
+            log.warning(
+                "group %s fence lease expired: epoch %d reverts to "
+                "committed epoch %d", self.group_id, expired.epoch,
+                self._map.epoch,
+            )
 
     @property
     def map(self) -> ShardMap:
+        self._lease_check()
         return self._map
 
     @property
     def epoch(self) -> int:
+        self._lease_check()
         return self._map.epoch
 
+    @property
+    def leased(self) -> bool:
+        self._lease_check()
+        return self._fallback is not None
+
+    def lease_remaining(self) -> float:
+        """Seconds until the current fence lease heals back (0 when the
+        installed map is committed)."""
+        self._lease_check()
+        if self._fallback is None:
+            return 0.0
+        return max(0.0, self._lease_at - self._clock())
+
     def owns(self, key: str) -> bool:
+        self._lease_check()
         return self._map.owner(key) == self.group_id
 
-    def install(self, smap: ShardMap, force: bool = False) -> None:
+    def install(self, smap: ShardMap, force: bool = False,
+                lease: float = 0.0) -> None:
         """Adopt a newer signed map. `force` permits an epoch rollback —
         reserved for the rebalancer's abort path, which restores the
-        previous map after a failed migration."""
+        previous map after a failed migration. `lease > 0` installs the
+        map PROVISIONALLY for that many seconds (see class docstring);
+        re-installing the same epoch with a lease renews it, and
+        installing with `lease=0` commits. A committed map never reverts."""
         if not smap.verify(self.secret):
             raise ValueError("shard map signature invalid")
+        self._lease_check()
         if self._map is not None and smap.epoch < self._map.epoch and not force:
             raise ValueError(
                 f"shard map epoch moved backwards "
                 f"({self._map.epoch} -> {smap.epoch})"
             )
+        if lease > 0:
+            if self._fallback is None:
+                # the map in force BEFORE the provisional install is the
+                # committed state the lease heals back to
+                self._fallback = self._map
+            self._lease_at = self._clock() + lease
+        else:
+            self._fallback, self._lease_at = None, 0.0
         self._map = smap
 
 

@@ -307,6 +307,17 @@ class ShardConfig:
     migrate_chunk_keys: int = 256
     manifest_timeout: float = 2.0
     ack_timeout: float = 5.0
+    # fence-lease TTL (seconds) for a reshard's freeze installs: a plan
+    # whose driver crashes before commit heals back to the committed map
+    # when the lease expires, so no group stays fenced forever. 0 keeps
+    # the legacy forever-fenced-until-next-install behavior. Size it
+    # comfortably above freeze->commit under load (attest + stream +
+    # one ack timeout)
+    fence_lease: float = 30.0
+    # directory for the crash-safe reshard plan journal (empty = keep
+    # plan state in memory only — fine for tests and ephemeral fleets,
+    # but a restarted driver then cannot resolve an interrupted plan)
+    plan_dir: str = ""
 
 
 @dataclass
@@ -488,6 +499,48 @@ class FabricConfig:
     # per-peer bootstrap attempt timeout; agent-RPC ack timeout
     bootstrap_timeout: float = 3.0
     rpc_timeout: float = 5.0
+    # total Deadline budget one agent control RPC may spend across
+    # retried attempts (rpc_timeout bounds each attempt); 0 derives
+    # ~3.5x rpc_timeout
+    rpc_budget: float = 0.0
+
+
+@dataclass
+class HelmsmanConfig:
+    """Helmsman fleet autoscaler (dds_tpu/fleet/helmsman): closes the
+    loop from SLO burn to fleet shape — splits a hot group onto a warm
+    standby under distress, merges a cold group back when calm, promotes
+    a standby over a dead group process. Hysteresis (streaks + cooldown)
+    and a migrated-bytes budget keep it from thrashing; `pin` (or the
+    controller's runtime `pin()`) freezes the shape for maintenance.
+    DEPLOY.md "Self-driving capacity (Helmsman)" is the runbook."""
+
+    enabled: bool = False
+    # decision tick period (seconds)
+    interval: float = 5.0
+    # consecutive hot/cold ticks required before acting
+    hot_streak: int = 3
+    cold_streak: int = 6
+    # a group's share of routed ops that counts as hot / cold
+    hot_share: float = 0.5
+    cold_share: float = 0.1
+    # minimum routed ops per tick for shares to be trusted at all
+    min_ops: int = 20
+    # fleet shape bounds
+    min_groups: int = 1
+    max_groups: int = 8
+    # quiet period after any action (seconds)
+    cooldown: float = 30.0
+    # migrated-bytes budget: at most `budget_bytes` of ciphertext may be
+    # re-moved per sliding `budget_window` seconds (the BTS cost model —
+    # goodput tracks how little you migrate)
+    budget_bytes: int = 67108864
+    budget_window: float = 600.0
+    # a group whose Panopticon heartbeat is older than this is DEAD and
+    # its keyspace is promoted onto a standby
+    heartbeat_timeout: float = 15.0
+    # start pinned (autoscaling frozen, liveness promotion still active)
+    pin: bool = False
 
 
 @dataclass
@@ -522,6 +575,7 @@ class DDSConfig:
     resident: ResidentConfig = field(default_factory=ResidentConfig)
     search: SearchConfig = field(default_factory=SearchConfig)
     fabric: FabricConfig = field(default_factory=FabricConfig)
+    helmsman: HelmsmanConfig = field(default_factory=HelmsmanConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     debug: bool = False
 
@@ -576,6 +630,7 @@ _SUBSECTIONS = {
     ("DDSConfig", "resident"): ResidentConfig,
     ("DDSConfig", "search"): SearchConfig,
     ("DDSConfig", "fabric"): FabricConfig,
+    ("DDSConfig", "helmsman"): HelmsmanConfig,
     ("DDSConfig", "crypto"): CryptoConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
     ("ObsConfig", "fleet"): FleetObsConfig,

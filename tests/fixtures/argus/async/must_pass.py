@@ -38,3 +38,22 @@ async def keeps_handles():
 async def holds_async_lock():
     async with _LOCK:
         await asyncio.sleep(0.1)
+
+
+_STOP = asyncio.Event()
+
+
+async def steer_loop():
+    """Helmsman-style periodic controller tick, sanctioned shape: the
+    loop is spawned supervised, each action is recorded through the
+    async flight recorder, and shared decision state sits behind an
+    ``asyncio.Lock``."""
+    while not _STOP.is_set():
+        async with _LOCK:
+            await flight.record_async("helmsman", action="tick")
+        await asyncio.sleep(0.1)
+
+
+def start_steering():
+    task = supervised_task(steer_loop(), name="fixture.steer")
+    return task

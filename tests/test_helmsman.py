@@ -1,0 +1,905 @@
+"""Helmsman self-steering-fleet tests (dds_tpu/fleet + shard/rebalance).
+
+Covers the acceptance surface of the autoscaling plane: the controller's
+decision tick (hot-streak split, cold-streak merge, hysteresis, cooldown,
+migrated-bytes budget, pin override, dead-group promotion), fence-lease
+expiry healing an abandoned freeze, crash-safe plan-journal recovery
+(deterministic roll-forward/roll-back), deadline-budgeted agent RPCs
+(typed DeadlineExceededError, never a hang), live merge + warm-standby
+reuse on a constellation, the hardened POST /_reshard route (serialized,
+idempotent, honest 409 + Retry-After) with the /_helmsman pin override,
+the crash-mid-reshard twin-fleet bit-for-bit test, and the flagship:
+a seeded ChaosNet fleet under a migrating Zipf hotspot where the
+controller's adaptive shape beats every static shape on
+goodput-per-group-hour while the history stays linearizable and the
+Watchtower audit stays silent.
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+
+from dds_tpu.core.chaos import ChaosNet
+from dds_tpu.core.transport import InMemoryNet
+from dds_tpu.fleet import Helmsman
+from dds_tpu.http.miniserver import http_request
+from dds_tpu.http.server import DDSRestServer, ProxyConfig
+from dds_tpu.obs.metrics import metrics
+from dds_tpu.shard import (
+    ReshardAborted,
+    ShardMap,
+    ShardState,
+    build_constellation,
+)
+from dds_tpu.shard.rebalance import PlanJournal
+from tests.test_core import run
+from tests.test_linearizability import Recorder, check_atomic_register
+
+pytestmark = pytest.mark.fleet
+
+SECRET = b"intranet-abd-secret"
+
+
+def constellation(S=2, net=None, seed=7, **kw):
+    net = net or InMemoryNet()
+    kw.setdefault("n_active", 4)
+    kw.setdefault("n_sentinent", 0)
+    kw.setdefault("quorum", 3)
+    return build_constellation(net, shard_count=S, vnodes_per_group=8,
+                               seed=seed, **kw), net
+
+
+# ----------------------------------------------------------- decision tick
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class _Sim:
+    """Hand-cranked signal/action bench for the controller: mutate the
+    public fields, call hm.step(), read .actions."""
+
+    def __init__(self, **kw):
+        self.clock = _Clock()
+        self.census = {"s0": 0, "s1": 0}
+        self.alerts = []
+        self.shed = 0
+        self.ages = {}
+        self.moved = 0
+        self.busy = False
+        self.actions = []
+        self.fail_actions = False
+
+        async def act(kind, gid):
+            if self.fail_actions:
+                raise ReshardAborted(f"injected {kind} failure")
+            self.actions.append((kind, gid))
+            self.moved += 1024
+
+        kw.setdefault("hot_streak", 3)
+        kw.setdefault("cold_streak", 4)
+        kw.setdefault("min_ops", 20)
+        kw.setdefault("cooldown", 30.0)
+        kw.setdefault("max_groups", 4)
+        self.hm = Helmsman(
+            load_census=lambda: dict(self.census),
+            slo_alerts=lambda: list(self.alerts),
+            shed_level=lambda: self.shed,
+            source_ages=lambda: dict(self.ages),
+            split=lambda g: act("split", g),
+            merge=lambda g: act("merge", g),
+            promote=lambda g: act("promote", g),
+            moved_bytes=lambda: self.moved,
+            reshard_busy=lambda: self.busy,
+            clock=self.clock,
+            **kw,
+        )
+
+    def load(self, **ops):
+        for gid, n in ops.items():
+            self.census[gid] = self.census.get(gid, 0) + n
+
+
+def test_helmsman_splits_hot_group_after_streak_and_cools_down():
+    async def go():
+        sim = _Sim()
+        hm = sim.hm
+        sim.alerts = ["write_availability"]
+        # two hot ticks: not yet (hysteresis)
+        for _ in range(2):
+            sim.load(s0=10, s1=90)
+            sim.clock.t += 5
+            assert await hm.step() is None
+        # third consecutive hot tick fires the split on the hot group
+        sim.load(s0=10, s1=90)
+        sim.clock.t += 5
+        assert await hm.step() == "split"
+        assert sim.actions == [("split", "s1")]
+        # cooldown: still hot, but no second action until it elapses
+        sim.load(s0=10, s1=90)
+        sim.clock.t += 5
+        assert await hm.step() is None
+        # a broken streak resets hysteresis: calm tick, then hot again
+        sim.clock.t += 40
+        sim.alerts = []
+        sim.load(s0=50, s1=50)
+        assert await hm.step() is None
+        sim.alerts = ["write_availability"]
+        for _ in range(2):
+            sim.load(s0=5, s1=95)
+            sim.clock.t += 5
+            assert await hm.step() is None  # streak restarted from zero
+        sim.load(s0=5, s1=95)
+        assert await hm.step() == "split"
+        # low-volume ticks never count toward a streak (min_ops gate)
+        sim.clock.t += 40
+        for _ in range(4):
+            sim.load(s1=5)  # only 5 ops: below min_ops
+            sim.clock.t += 5
+            assert await hm.step() is None
+        assert len(sim.actions) == 2
+
+    run(go())
+
+
+def test_helmsman_merges_cold_group_only_when_calm_and_unshedded():
+    async def go():
+        # hot_streak=99: the hot side never fires in this sim, so the
+        # 98%-share group can't mask the cold-side assertions
+        sim = _Sim(cold_streak=3, hot_streak=99)
+        hm = sim.hm
+        # calm fleet, s1 nearly idle -> merge after the cold streak
+        for _ in range(2):
+            sim.load(s0=98, s1=2)
+            sim.clock.t += 5
+            assert await hm.step() is None
+        sim.load(s0=98, s1=2)
+        assert await hm.step() == "merge"
+        assert sim.actions == [("merge", "s1")]
+        # shedding forbids merging capacity away: streak never accrues
+        sim.clock.t += 40
+        sim.shed = 1
+        for _ in range(5):
+            sim.load(s0=98, s1=2)
+            sim.clock.t += 5
+            assert await hm.step() is None
+        # distress also blocks the cold side
+        sim.shed = 0
+        sim.alerts = ["latency"]
+        for _ in range(5):
+            sim.load(s0=98, s1=2)
+            sim.clock.t += 5
+            assert await hm.step() is None
+        assert len(sim.actions) == 1
+        # min_groups floor: a 1-group fleet never merges further
+        lone = _Sim(cold_streak=1, min_groups=1)
+        lone.census = {"s0": 0}
+        lone.hm._last_counts = {"s0": 0}
+        lone.load(s0=100)
+        lone.clock.t += 5
+        assert await lone.hm.step() is None
+
+    run(go())
+
+
+def test_helmsman_budget_pin_busy_and_failed_action():
+    async def go():
+        sim = _Sim(hot_streak=1, budget_bytes=2000, budget_window=100.0,
+                   cooldown=5.0)
+        hm = sim.hm
+        sim.alerts = ["burn"]
+
+        async def hot_tick():
+            sim.load(s0=5, s1=95)
+            sim.clock.t += 6  # always past the cooldown
+            return await hm.step()
+
+        assert await hot_tick() == "split"        # charges 1024 bytes
+        assert await hot_tick() == "split"        # charges 1024 more
+        assert hm.budget_remaining() == 0
+        assert await hot_tick() is None           # budget exhausted
+        assert metrics.value("dds_helmsman_budget_exhausted") == 1
+        sim.clock.t += 200                        # window slides clear
+        assert await hot_tick() == "split"
+        # pinned: shape frozen even under distress
+        hm.pin()
+        assert await hot_tick() is None
+        assert hm.report()["pinned"]
+        hm.unpin()
+        # a reshard already holding the lock defers the tick
+        sim.busy = True
+        assert await hot_tick() is None
+        sim.busy = False
+        # a failed action cools down instead of hammering the same plan
+        n = len(sim.actions)
+        sim.fail_actions = True
+        assert await hot_tick() is None
+        assert any(r["action"] == "split_failed" for r in hm.history)
+        sim.fail_actions = False
+        sim.load(s0=5, s1=95)
+        sim.clock.t += 1  # inside the failure cooldown
+        assert await hm.step() is None
+        assert len(sim.actions) == n
+
+    run(go())
+
+
+def test_helmsman_promotes_dead_group_even_when_pinned():
+    async def go():
+        sim = _Sim(heartbeat_timeout=15.0, cooldown=10.0)
+        hm = sim.hm
+        hm.pin()  # a pin must never turn a crash into an unserved keyspace
+        sim.load(s0=50, s1=50)
+        sim.ages = {"s0": 0.2, "s1": 40.0}  # s1's shipper went silent
+        assert await hm.step() == "promote"
+        assert sim.actions == [("promote", "s1")]
+        # the takeover is not re-launched while the first one settles
+        sim.clock.t += 5
+        assert await hm.step() is None
+        assert sim.actions == [("promote", "s1")]
+        # an unknown gid (not in the census) never triggers a takeover
+        sim.ages = {"ghost": 99.0}
+        sim.clock.t += 60
+        assert await hm.step() is None
+        # a failed promotion is recorded, not raised
+        sim.ages = {"s0": 50.0}
+        sim.fail_actions = True
+        sim.clock.t += 60
+        assert await hm.step() is None
+        assert any(r["action"] == "promote_failed" for r in hm.history)
+
+    run(go())
+
+
+def test_helmsman_from_config_and_report_shape():
+    from dds_tpu.utils.config import HelmsmanConfig
+
+    cfg = HelmsmanConfig(hot_streak=7, budget_bytes=123, pin=True)
+    hm = Helmsman.from_config(cfg, load_census=lambda: {})
+    assert hm.hot_streak == 7 and hm.budget_bytes == 123 and hm.pinned
+    rep = hm.report()
+    for k in ("pinned", "ticks", "cooldown_remaining",
+              "budget_remaining_bytes", "recent"):
+        assert k in rep
+
+
+# ------------------------------------------------------------- fence lease
+
+
+def test_fence_lease_expires_back_to_committed_map():
+    clk = _Clock()
+    m1 = ShardMap.build(["s0", "s1"], 8).sign(SECRET)
+    st = ShardState("s1", m1, SECRET, clock=clk)
+    m2 = m1.split("s1", "s2").sign(SECRET)
+    before = metrics.value("dds_shard_lease_expired_total",
+                           shard="s1") or 0
+    st.install(m2, lease=5.0)
+    assert st.leased and st.epoch == m2.epoch
+    assert 0 < st.lease_remaining() <= 5.0
+    # renewal pushes the horizon out
+    clk.t += 4
+    st.install(m2, lease=5.0)
+    clk.t += 4  # 8s after the first install: only alive because renewed
+    assert st.leased and st.epoch == m2.epoch
+    # the driver dies: expiry heals the state back to the committed map
+    clk.t += 2
+    assert not st.leased
+    assert st.epoch == m1.epoch and st.map is m1
+    assert (metrics.value("dds_shard_lease_expired_total", shard="s1")
+            or 0) == before + 1
+    # a committed install never reverts, no matter how long
+    st.install(m2, lease=5.0)
+    st.install(m2)  # commit
+    clk.t += 1000
+    assert st.epoch == m2.epoch and not st.leased
+
+
+# ------------------------------------------------------------ plan journal
+
+
+def test_plan_journal_atomic_roundtrip(tmp_path):
+    j = PlanJournal(str(tmp_path))
+    assert j.load() is None
+    j.write({"kind": "split", "phase": "freeze"})
+    assert PlanJournal(str(tmp_path)).load() == {"kind": "split",
+                                                 "phase": "freeze"}
+    # corrupt file: warn-and-None, never raise
+    j.path.write_text("{nope")
+    assert j.load() is None
+    j.clear()
+    assert not j.path.exists()
+    mem = PlanJournal(None)
+    mem.write({"a": 1})
+    assert mem.load() == {"a": 1}
+    mem.clear()
+    assert mem.load() is None
+
+
+def _journal_plan(kind, source, targets, old, new, phase):
+    return {"kind": kind, "source": source, "targets": targets,
+            "old": old.to_wire(), "new": new.to_wire(), "phase": phase}
+
+
+def test_recover_rolls_back_before_commit(tmp_path):
+    async def go():
+        const, net = constellation(S=2, journal_dir=str(tmp_path),
+                                   fence_lease=30.0)
+        old = const.manager.current()
+        new = old.merge("s1").sign(SECRET)
+        # a crashed driver froze both participants and died mid-stream
+        for gid in ("s0", "s1"):
+            const.group(gid).state.install(new, lease=30.0)
+        PlanJournal(str(tmp_path)).write(
+            _journal_plan("merge", "s1", ["s0"], old, new, "stream"))
+        assert await const.rebalancer.recover(const.group) == "rollback"
+        # the old map is the truth again, committed (no lease), everywhere
+        for gid in ("s0", "s1"):
+            st = const.group(gid).state
+            assert st.epoch == old.epoch and not st.leased
+        assert const.manager.epoch == old.epoch
+        assert PlanJournal(str(tmp_path)).load() is None
+        await const.stop()
+
+    run(go())
+
+
+def test_recover_rolls_forward_from_commit(tmp_path):
+    async def go():
+        const, net = constellation(S=2, journal_dir=str(tmp_path),
+                                   fence_lease=30.0)
+        old = const.manager.current()
+        key = next(k for k in (f"RF{i}" for i in range(64))
+                   if old.owner(k) == "s0")
+        await const.router.write_set(key, ["kept"])
+        new = old.merge("s1").sign(SECRET)
+        # the crashed driver got past the commit point: participants hold
+        # committed new-map fencing, only activation is missing
+        for gid in ("s0", "s1"):
+            const.group(gid).state.install(new)
+        PlanJournal(str(tmp_path)).write(
+            _journal_plan("merge", "s1", ["s0"], old, new, "commit"))
+        seen = []
+        const.rebalancer.on_activate = seen.append
+        assert await const.rebalancer.recover(const.group) == "rollforward"
+        assert const.manager.epoch == new.epoch
+        assert seen and seen[0].epoch == new.epoch  # broadcast ran
+        assert PlanJournal(str(tmp_path)).load() is None
+        # the fleet serves under the recovered map
+        assert await const.router.fetch_set(key) == ["kept"]
+        await const.stop()
+
+    run(go())
+
+
+# ------------------------------------------------------- deadline-budgeted
+
+
+def test_agent_rpc_deadline_exceeds_typed_never_hangs():
+    from dds_tpu.fabric.remote import AgentClient, AgentTimeout
+    from dds_tpu.utils.retry import DeadlineExceededError
+
+    async def go():
+        net = InMemoryNet()  # nobody is listening at "meridian-ctl"
+        cli = AgentClient(net, "probe", timeout=0.05, budget=0.2)
+        smap = ShardMap.build(["s0"], 4).sign(SECRET)
+        t0 = time.monotonic()
+        with pytest.raises((AgentTimeout, DeadlineExceededError)):
+            await cli.install("meridian-ctl", smap)
+        assert time.monotonic() - t0 < 2.0  # budget-bounded, not hung
+        # a caller-scoped Deadline wins over the client default
+        from dds_tpu.utils.retry import Deadline
+
+        t0 = time.monotonic()
+        with pytest.raises((AgentTimeout, DeadlineExceededError)):
+            await cli.activate("meridian-ctl", smap,
+                              deadline=Deadline(0.08))
+        assert time.monotonic() - t0 < 1.0
+
+    run(go())
+
+
+# ------------------------------------------------------- live merge + reuse
+
+
+def test_constellation_merge_end_to_end_and_standby_reuse():
+    async def go():
+        const, net = constellation(S=2)
+        r = const.router
+        keys = [f"MRG-{i}" for i in range(24)]
+        for k in keys:
+            await r.write_set(k, [k])
+        assert {r.owner(k) for k in keys} == {"s0", "s1"}
+        receivers = await const.merge("s1")
+        assert receivers == ["s0"]
+        assert const.gids == ["s0"]
+        assert [g.gid for g in const.standbys] == ["s1"]
+        assert const.manager.epoch == 2
+        for k in keys:
+            assert await r.fetch_set(k) == [k]  # nothing lost in the fold
+        await net.quiesce()
+        # the victim was pruned: it holds none of the migrated keys
+        victim = const.standbys[0]
+        for n in victim.replicas.values():
+            for k in keys:
+                assert n.repository.get(k, (None, None))[1] is None
+        assert const.rebalancer.moved_bytes_total > 0
+        # the next split REUSES the warm standby instead of building new
+        g = await const.split("s0")
+        assert g.gid == "s1" and not const.standbys
+        assert const.manager.epoch == 3
+        assert {r.owner(k) for k in keys} == {"s0", "s1"}
+        for k in keys:
+            assert await r.fetch_set(k) == [k]
+        await const.stop()
+
+    run(go())
+
+
+# -------------------------------------------------------- hardened /_reshard
+
+
+def test_reshard_route_serialized_idempotent_and_pin_override():
+    from dds_tpu.http.miniserver import http_request_full
+    from dds_tpu.run import ConstellationReshard
+
+    async def go():
+        const, net = constellation(S=2)
+        ctl = ConstellationReshard(const)
+        gate = asyncio.Event()
+        orig_split = ctl.split
+
+        async def gated_split(source, target=None):
+            await gate.wait()
+            return await orig_split(source, target)
+
+        ctl.split = gated_split
+        hm = Helmsman(load_census=lambda: {})
+        server = DDSRestServer(
+            const.router, ProxyConfig(port=0, reshard_route_enabled=True),
+            reshard=ctl, helmsman=hm,
+        )
+        await server.start()
+        port = server.cfg.port
+
+        async def post(obj):
+            return await http_request_full(
+                "127.0.0.1", port, "POST", "/_reshard",
+                json.dumps(obj).encode(), timeout=30.0)
+
+        try:
+            first = asyncio.ensure_future(post({"source": "s1"}))
+            second = asyncio.ensure_future(post({"source": "s1"}))
+            await asyncio.sleep(0.1)
+            assert not first.done() and not second.done()
+            # a DIFFERENT plan is refused honestly while one is in flight
+            st, hdrs, body = await post({"action": "merge", "source": "s0"})
+            assert st == 409
+            d = json.loads(body)
+            assert d["busy"] == {"action": "split", "source": "s1",
+                                 "target": None}
+            assert int(hdrs["retry-after"]) >= 1
+            gate.set()
+            (st1, _, b1), (st2, _, b2) = await asyncio.gather(first, second)
+            # the identical repeat attached to the SAME plan: one epoch
+            # bump, both callers see the same result
+            assert st1 == 200 and st2 == 200 and b1 == b2
+            assert json.loads(b1)["epoch"] == 2
+            assert const.manager.epoch == 2
+            assert sorted(json.loads(b1)["groups"]) == ["s0", "s1", "s2"]
+            # COMPLETED idempotency: replaying a split whose target is
+            # already in the map answers the map, moves nothing
+            st, _, body = await post({"source": "s1", "target": "s2"})
+            assert st == 200 and json.loads(body)["idempotent"]
+            assert const.manager.epoch == 2
+            # merge through the route works and is itself replay-safe
+            st, _, body = await post({"action": "merge", "source": "s2"})
+            assert st == 200 and json.loads(body)["epoch"] == 3
+            st, _, body = await post({"action": "merge", "source": "s2"})
+            assert st == 200 and json.loads(body)["idempotent"]
+            # validation: bad action / missing source
+            st, _, _ = await post({"action": "explode", "source": "s1"})
+            assert st == 400
+            st, _, _ = await post({"action": "split"})
+            assert st == 400
+            # /_helmsman pin override round-trips and shows in /health
+            st, body = await http_request(
+                "127.0.0.1", port, "POST", "/_helmsman",
+                json.dumps({"pin": True}).encode(), timeout=10.0)
+            assert st == 200 and json.loads(body)["pinned"]
+            st, body = await http_request(
+                "127.0.0.1", port, "GET", "/health", timeout=10.0)
+            assert json.loads(body)["helmsman"]["pinned"]
+            st, _, body = await http_request_full(
+                "127.0.0.1", port, "POST", "/_helmsman",
+                json.dumps({"pin": False}).encode(), timeout=10.0)
+            assert st == 200 and not json.loads(body)["pinned"]
+        finally:
+            await server.stop()
+            await const.stop()
+
+    run(go())
+
+
+# ------------------------------------------------------ crash-safe reshard
+
+
+@pytest.mark.chaos
+def test_crash_mid_split_and_mid_merge_twin_fleet_bit_for_bit(tmp_path):
+    """Acceptance (ISSUE 15): a group process killed mid-split (stream
+    phase) and mid-merge is detected, the plan resolves deterministically
+    (rollback here — the crash lands before the commit point), the dead
+    participant's fence lease expires back to serving, and post-recovery
+    SumAll/Search answers are bit-for-bit equal to an undisturbed twin
+    fleet. The 'kill' is total: the group's replicas drop off the net AND
+    its (shared) state handle refuses installs, so the abort's rollback
+    cannot reach it — only the lease can heal it."""
+    from dds_tpu.models import HEKeys
+    from dds_tpu.utils.config import SearchConfig
+
+    he = HEKeys.generate(paillier_bits=512, rsa_bits=512)
+    pk = he.psse.public
+    vals = [(7, "red"), (21, "blue"), (301, "red"),
+            (44, "green"), (5, "red"), (600, "blue")]
+    rows = [[str(pk.encrypt(v)), c] for v, c in vals]  # ONE encryption
+
+    async def build(tag):
+        net = ChaosNet(InMemoryNet(), seed=41)
+        const, _ = constellation(
+            S=2, net=net, seed=5, manifest_timeout=0.4, ack_timeout=0.3,
+            fence_lease=1.0, journal_dir=str(tmp_path / tag))
+        server = DDSRestServer(const.router, ProxyConfig(
+            port=0, crypto_backend="cpu",
+            search=SearchConfig(enabled=True, write_ingest=True,
+                                ingest_window=0.001)))
+        await server.start()
+        for row in rows:
+            st, _ = await http_request(
+                "127.0.0.1", server.cfg.port, "POST", "/PutSet",
+                json.dumps({"contents": row}).encode(), timeout=10.0)
+            assert st == 200
+        return net, const, server
+
+    async def results(server):
+        st, body = await http_request(
+            "127.0.0.1", server.cfg.port, "GET",
+            f"/SumAll?position=0&nsqr={pk.nsquare}", timeout=30.0)
+        assert st == 200
+        total = json.loads(body)["result"]
+        st, body = await http_request(
+            "127.0.0.1", server.cfg.port, "POST", "/SearchEq?position=1",
+            json.dumps({"value": "red"}).encode(), timeout=30.0)
+        assert st == 200
+        return total, sorted(json.loads(body)["keyset"])
+
+    def kill_at_stream(net, reb, state, replicas):
+        """At stream entry: the group's process dies — frames drop and
+        the shared state handle stops answering installs."""
+        orig_enter, orig_install = reb._enter, state.install
+
+        def dead_install(m, force=False, lease=0.0):
+            raise RuntimeError("group process is dead")
+
+        def spy(phase, **info):
+            orig_enter(phase, **info)
+            if phase == "stream":
+                net.partition(replicas)
+                state.install = dead_install
+
+        reb._enter = spy
+
+        def revive():
+            reb._enter = orig_enter
+            state.install = orig_install
+            net.heal_all()
+
+        return revive
+
+    async def go():
+        netA, A, srvA = await build("A")
+        netB, B, srvB = await build("B")
+        try:
+            old = A.manager.current()
+
+            # ---- killed mid-SPLIT: the stream-phase TARGET dies
+            with pytest.raises(ReshardAborted):
+                # arm inside the same block: the target group only exists
+                # once the split acquires it, but its gid is deterministic
+                revive = None
+                try:
+                    orig_acquire = A._acquire_standby
+
+                    def acquiring(gid=None):
+                        g = orig_acquire(gid)
+                        nonlocal revive
+                        revive = kill_at_stream(
+                            netA, A.rebalancer, g.state, g.all_replicas())
+                        return g
+
+                    A._acquire_standby = acquiring
+                    await A.split("s1")
+                finally:
+                    A._acquire_standby = orig_acquire
+            assert A.manager.current() is old
+            assert A.manager.state == "stable"
+            # the dead target still holds the provisional freeze: only
+            # its fence lease can heal it back to the committed map
+            standby = A.standbys[0]
+            assert standby.gid == "s2" and standby.state.leased
+            await asyncio.sleep(1.2)
+            assert not standby.state.leased
+            assert standby.state.epoch == old.epoch
+            revive()
+
+            # ---- killed mid-MERGE: the stream-phase RECEIVER dies
+            s0 = A.group("s0")
+            revive = kill_at_stream(netA, A.rebalancer, s0.state,
+                                    s0.all_replicas())
+            with pytest.raises(ReshardAborted):
+                await A.merge("s1")
+            assert A.manager.current() is old
+            assert A.gids == ["s0", "s1"]  # the victim was never retired
+            assert s0.state.leased  # the rollback could not reach it
+            await asyncio.sleep(1.2)
+            assert not s0.state.leased and s0.state.epoch == old.epoch
+            revive()
+            await netA.quiesce()
+
+            # both plans resolved: no journal entry survives
+            assert PlanJournal(str(tmp_path / "A")).load() is None
+
+            # ---- bit-for-bit vs the undisturbed twin
+            got, want = await results(srvA), await results(srvB)
+            assert got == want
+            assert he.psse.decrypt(int(got[0])) == sum(v for v, _ in vals)
+            assert got[1]  # the search really matched rows
+        finally:
+            netA.heal_all()
+            for s in (srvA, srvB):
+                await s.stop()
+            for c in (A, B):
+                await c.stop()
+
+    run(go())
+
+
+# ----------------------------------------------------- flagship: autoscale
+
+
+@pytest.mark.chaos
+def test_adaptive_fleet_beats_static_shapes_on_goodput_per_group_hour():
+    """Acceptance (ISSUE 15): under a seeded ChaosNet and an open-loop
+    Zipf-style load whose hotspot migrates mid-run, the Helmsman-steered
+    fleet splits the hot group onto a standby, merges cooled capacity
+    back, and beats EVERY static shape S in {1, 2, 4} on goodput per
+    group-hour over the identical arrival schedule — while a concurrent
+    write history linearizes and a Watchtower with per-group geometry
+    reports zero quorum-intersection / tag-monotonicity violations.
+
+    Capacity model: each serving group has LANES concurrent service
+    lanes (SERVICE seconds per op); an op is GOOD iff it finishes within
+    SLO of its scheduled arrival. The model prices fleet shape the way
+    the paper's cost model prices migration: groups you keep are paid
+    for whether the hotspot uses them or not."""
+    from dds_tpu.core.chaos import LinkFaults
+    from dds_tpu.obs.watchtower import Watchtower
+    from dds_tpu.utils.retry import Deadline, RetryPolicy, retry_deadline
+    from dds_tpu.utils.trace import tracer
+
+    LANES, SERVICE, SLO = 4, 0.004, 0.12
+    RATE, P_HOT, TAIL_RATE = 1600.0, 0.9, 600.0
+    PHASE, TAIL = 1.0, 0.9
+
+    # ---- hot-key selection: a genuine arc hotspot — the same 6 keys are
+    # hot under EVERY fleet shape (they cluster on one group's arc in the
+    # 2-group AND 4-group rings), and a midpoint split divides them
+    map2 = ShardMap.build(["s0", "s1"], 8)
+    map4 = ShardMap.build(["s0", "s1", "s2", "s3"], 8)
+    split2 = map2.split("s1", "s2")
+
+    def pick_hot(owner2, splitmap, new_gid):
+        import collections as C
+
+        cand = [f"LOAD-{i}" for i in range(400)
+                if map2.owner(f"LOAD-{i}") == owner2]
+        dom = C.Counter(map4.owner(k) for k in cand).most_common(1)[0][0]
+        cand = [k for k in cand if map4.owner(k) == dom]
+        stay = [k for k in cand if splitmap.owner(k) == owner2][:3]
+        move = [k for k in cand if splitmap.owner(k) == new_gid][:3]
+        assert len(stay) == 3 and len(move) == 3
+        return stay + move
+
+    hot_a = pick_hot("s1", split2, "s2")
+    hot_b = pick_hot("s0", split2.split("s0", "s3"), "s3")
+    uniform = [f"U-{i}" for i in range(52)]
+    universe = uniform + hot_a + hot_b
+
+    # ---- one seeded open-loop schedule, shared by every run
+    rng = random.Random(0xF1EE7)
+    sched = []
+    t = 0.0
+    while t < 2 * PHASE:
+        t += 1.0 / RATE
+        hot = hot_a if t < PHASE else hot_b
+        key = (hot[rng.randrange(len(hot))] if rng.random() < P_HOT
+               else universe[rng.randrange(len(universe))])
+        sched.append((t, key))
+    while t < 2 * PHASE + TAIL:  # cool tail: load concentrates back on A
+        t += 1.0 / TAIL_RATE
+        key = (hot_a[rng.randrange(len(hot_a))] if rng.random() < 0.7
+               else universe[rng.randrange(len(universe))])
+        sched.append((t, key))
+
+    _POLICY = RetryPolicy(base=0.01, multiplier=2.0, max_delay=0.08)
+
+    async def writer(router, rec, key, wid, n, seed):
+        w_rng = random.Random(seed)
+        for i in range(n):
+            value = [f"w{wid}-{i}"]
+            t0 = time.monotonic()
+            dl = Deadline(10.0)
+            await retry_deadline(
+                lambda: router.write_set(key, value, deadline=dl),
+                dl, _POLICY, rng=w_rng, retry_on=(Exception,),
+            )
+            rec.record("write", f"w{wid}-{i}", t0, time.monotonic())
+            await asyncio.sleep(w_rng.uniform(0.01, 0.04))
+
+    async def run_shape(S, adaptive):
+        net = ChaosNet(InMemoryNet(), seed=99)
+        net.default_faults = LinkFaults(jitter=0.002)  # seeded chaos
+        const, _ = constellation(S=S, net=net, seed=13)
+        r = const.router
+        for k in universe:
+            await r.write_set(k, [k])
+        lanes: dict = {}
+        counts: dict = {}
+        stats = {"good": 0, "done": 0, "backlog": 0, "integral": 0.0}
+        t0 = time.monotonic()
+
+        async def op(due, key):
+            delay = due - (time.monotonic() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            stats["backlog"] += 1
+            gid = r.owner(key)
+            counts[gid] = counts.get(gid, 0) + 1
+            sem = lanes.setdefault(gid, asyncio.Semaphore(LANES))
+            async with sem:
+                await asyncio.sleep(SERVICE)
+            stats["backlog"] -= 1
+            stats["done"] += 1
+            if (time.monotonic() - t0) - due <= SLO:
+                stats["good"] += 1
+
+        hm = None
+        if adaptive:
+            hm = Helmsman(
+                load_census=lambda: dict(counts),
+                slo_alerts=lambda: (["goodput_burn"]
+                                    if stats["backlog"] > 80 else []),
+                split=const.split,
+                merge=const.merge,
+                moved_bytes=lambda: const.rebalancer.moved_bytes_total,
+                reshard_busy=const.rebalancer.lock.locked,
+                hot_streak=2, cold_streak=3, hot_share=0.55,
+                cold_share=0.15, min_ops=15, cooldown=0.35,
+                max_groups=4, budget_bytes=1 << 30,
+            )
+        stop = asyncio.Event()
+
+        async def sample():  # group-seconds you pay for, 20ms resolution
+            while not stop.is_set():
+                stats["integral"] += len(const.groups) * 0.02
+                await asyncio.sleep(0.02)
+
+        ticklog = []
+
+        async def steer():  # the controller tick; never blocks sampling
+            while not stop.is_set():
+                await hm.step()
+                ticklog.append((round(time.monotonic() - t0, 2),
+                                stats["backlog"],
+                                dict(hm._cold_streaks),
+                                {g: round(s, 2)
+                                 for g, s in hm._shares.__self__._last_counts.items()}))
+                await asyncio.sleep(0.1)
+
+        sampler = asyncio.ensure_future(sample())
+        steerer = (asyncio.ensure_future(steer()) if hm is not None
+                   else None)
+        tasks = [asyncio.ensure_future(op(due, key)) for due, key in sched]
+        side = []
+        rec = Recorder()
+        if adaptive:
+            wkey_a = hot_a[0]
+            wkey_u = next(k for k in uniform if map2.owner(k) == "s0")
+            side = [asyncio.ensure_future(
+                        writer(r, rec, wkey_a, 0, 18, seed=31)),
+                    asyncio.ensure_future(
+                        writer(r, rec, wkey_u, 1, 18, seed=32))]
+        await asyncio.gather(*tasks, *side)
+        stop.set()
+        await sampler
+        if steerer is not None:
+            await steerer
+        if adaptive:
+            check_atomic_register(
+                [o for o in rec.ops if o["kind"] == "write"])
+            assert await r.fetch_set(wkey_a) == ["w0-17"]
+        # every preloaded key survived whatever resharding happened
+        for k in universe[::7]:
+            assert await r.fetch_set(k) == [k]
+        history = list(hm.history) if hm else []
+        await const.stop()
+        score = stats["good"] / max(stats["integral"], 1e-9)
+        return score, stats, history, ticklog
+
+    async def go():
+        wt = Watchtower(quorum_size=3, n_replicas=4)
+        wt.configure(group_geometry={f"s{i}": (3, 4) for i in range(6)})
+        wt.attach(tracer)
+        try:
+            adaptive_score, a_stats, history, tl = await run_shape(2, True)
+            bad = [v for v in wt.verdicts() if v.invariant in
+                   ("quorum_intersection", "tag_monotonicity")]
+            assert not bad, bad
+        finally:
+            wt.detach()
+        done = {r["action"] for r in history}
+        assert "split_done" in done, history  # the hot group really split
+        assert "merge_done" in done, (history, tl[-12:])
+        scores = {}
+        for S in (1, 2, 4):
+            scores[S], _, _, _ = await run_shape(S, False)
+        for S, s in scores.items():
+            assert adaptive_score > s, (
+                f"adaptive {adaptive_score:.1f} <= static S={S} {s:.1f} "
+                f"goodput/group-s (adaptive stats: {a_stats})"
+            )
+
+    run(go())
+
+
+# ----------------------------------------------------------------- sentry
+
+
+def test_sentry_check_parses_autoscale_records(tmp_path):
+    from benchmarks.sentry import _check_autoscale_records
+
+    bench = tmp_path / "benchmarks"
+    bench.mkdir()
+    good = {
+        "metric": "autoscale goodput",
+        "value": 237.6, "unit": "good/group-s", "vs_baseline": 1.516,
+        "detail": {
+            "static_score": 156.7, "splits": 2, "merges": 1,
+            "moved_bytes": 2745, "open_loop": True,
+        },
+    }
+    (bench / "results.json").write_text(json.dumps([good]))
+    assert _check_autoscale_records(str(tmp_path)) == {"rows": 1}
+    # closed-loop or action-less records are malformed: the score is only
+    # comparable when measured from scheduled arrivals, and a row that
+    # cannot say what the controller DID cannot justify its group-seconds
+    for broken in (
+        dict(good, value=-1),
+        dict(good, detail=dict(good["detail"], open_loop=False)),
+        dict(good, detail=dict(good["detail"], splits=None)),
+        dict(good, detail={"static_score": 1.0}),
+    ):
+        (bench / "results.json").write_text(json.dumps([good, broken]))
+        with pytest.raises(ValueError):
+            _check_autoscale_records(str(tmp_path))
+    # other record families are ignored by this checker
+    (bench / "results.json").write_text(
+        json.dumps([{"metric": "overload goodput interactive", "value": -1}])
+    )
+    assert _check_autoscale_records(str(tmp_path)) == {"rows": 0}

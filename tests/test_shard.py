@@ -89,6 +89,93 @@ def test_shardmap_split_moves_only_victim_keys():
             assert m1.owner(k) == m2.owner(k)
 
 
+def test_shardmap_merge_inverts_split_on_random_rings():
+    """Property: merge(split(m)) == m (epoch aside) for random rings —
+    the new group's vnodes are retired and every key it briefly owned
+    falls back to its original arc, so ownership is bit-identical."""
+    rng = random.Random(0xD5)
+    keys = [f"P{i}" for i in range(256)]
+    for trial in range(24):
+        n_groups = rng.randint(1, 5)
+        groups = [f"g{trial}x{i}" for i in range(n_groups)]
+        # a one-vnode ring has no splittable arc (its own predecessor)
+        vpg = rng.choice([2, 4, 8, 16] if n_groups == 1 else [1, 2, 4, 8])
+        m = ShardMap.build(groups, vpg)
+        victim = rng.choice(groups)
+        m2 = m.split(victim, "sNEW")
+        m3 = m2.merge("sNEW")
+        assert m3.epoch == m.epoch + 2
+        assert m3.vnodes == m.vnodes
+        assert m3.groups == m.groups
+        assert [m3.owner(k) for k in keys] == [m.owner(k) for k in keys]
+
+
+def test_shardmap_merge_moves_only_victim_keys():
+    """Merge locality: the only keys whose owner changes are those the
+    victim owned, and they land exactly on the ring-successor absorbers
+    the map itself advertises."""
+    rng = random.Random(0xA7)
+    keys = [f"M{i}" for i in range(512)]
+    for trial in range(16):
+        n_groups = rng.randint(2, 6)
+        groups = [f"h{trial}x{i}" for i in range(n_groups)]
+        m1 = ShardMap.build(groups, rng.choice([2, 4, 8]))
+        victim = rng.choice(groups)
+        m2 = m1.merge(victim)
+        assert m2.epoch == m1.epoch + 1
+        assert victim not in m2.groups
+        moved = moved_keys(m1, m2, keys)
+        absorbers = m1.absorbers(victim)
+        for k in moved:
+            assert m1.owner(k) == victim
+            assert m2.owner(k) in absorbers
+        for k in keys:
+            if k not in moved:
+                assert m1.owner(k) == m2.owner(k)
+    # degenerate shapes refuse instead of corrupting the ring
+    lone = ShardMap.build(["s0"], 4)
+    with pytest.raises(ValueError):
+        lone.merge("s0")
+    with pytest.raises(ValueError):
+        ShardMap.build(["s0", "s1"], 4).merge("sX")
+
+
+def test_shardmap_merge_signed_manifest_across_epoch_bump():
+    """The merge result signs/verifies like any other map, survives a
+    wire round-trip, rejects tampering, and activates at the manager
+    across the epoch bump — while the unsigned intermediate does not."""
+    from dds_tpu.shard import ShardManager
+
+    m1 = ShardMap.build(["s0", "s1", "s2"], 8).sign(SECRET)
+    merged = m1.merge("s2")
+    assert not merged.verify(SECRET)  # unsigned intermediate
+    signed = merged.sign(SECRET)
+    assert signed.verify(SECRET) and not signed.verify(b"forged")
+    rt = ShardMap.from_wire(signed.to_wire())
+    assert rt.verify(SECRET) and rt.epoch == m1.epoch + 1
+    mgr = ShardManager(m1, SECRET)
+    mgr.activate(rt)
+    assert mgr.epoch == m1.epoch + 1
+    with pytest.raises(ValueError):
+        mgr.activate(rt)  # epochs only move forward
+
+
+def test_shardmap_relabel_is_arc_identical_takeover():
+    m1 = ShardMap.build(["s0", "s1", "s2"], 8).sign(SECRET)
+    m2 = m1.relabel("s1", "s9")
+    assert m2.epoch == m1.epoch + 1
+    assert "s1" not in m2.groups and "s9" in m2.groups
+    assert [p for p, _ in m2.vnodes] == [p for p, _ in m1.vnodes]
+    keys = [f"T{i}" for i in range(256)]
+    for k in keys:
+        old, new = m1.owner(k), m2.owner(k)
+        assert new == ("s9" if old == "s1" else old)
+    with pytest.raises(ValueError):
+        m1.relabel("sX", "s9")
+    with pytest.raises(ValueError):
+        m1.relabel("s1", "s0")
+
+
 # ------------------------------------------------------------ point routing
 
 
