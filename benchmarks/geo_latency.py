@@ -1,0 +1,188 @@
+"""Geo latency: read-local quorum leases vs cross-region quorum reads.
+
+The claim behind ISSUE 16 (Atlas): on a region-spanning replica group
+under WAN latency, a plain ABD read pays two cross-region phases (read
++ write-back) per operation, so its p95 tracks the WAN round-trip; a
+client holding a read-local quorum lease answers the same read in one
+intra-region hop, because the lease pins every write quorum to include
+the holder.  Safety survives revocation: when the lease is pulled out
+from under the client mid-run, reads degrade to the full quorum round
+(never to a stale answer) until a fresh lease is granted.
+
+The harness drives ONE seeded write/read schedule twice against a fresh
+3-region span constellation under an identical seeded `wan-*` ChaosNet
+mesh each time:
+
+- leased: client homed in r0 with leases on — reads take the single-hop
+  fast path; halfway through, every group's r0 lease is revoked
+  table-side, forcing refusals -> full-quorum fallbacks -> re-grant;
+- quorum: leases off — every read is a full cross-region ABD round.
+
+Every read is checked against the last acked write for its key (the
+schedule is sequential, so any older value is a staleness violation);
+`stale_reads` in the record counts violations across BOTH runs and must
+be zero.
+
+Reported record (`geo latency`, parsed by benchmarks/sentry.py
+--check): value = quorum_p95 / local_p95 speedup, vs_baseline = the
+same ratio, detail = both p95s (ms), read/lease/fallback censuses, the
+WAN preset, and the revocation marker.
+
+Usage: python -m benchmarks.geo_latency [--reads 96] [--keys 6]
+       [--preset wan-100] [--scale 1.0] [--seed 31]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+import time
+
+from benchmarks.common import emit
+
+
+def _metric_sum(name: str, **match) -> float:
+    """Sum a counter family over every label set matching `match`."""
+    from dds_tpu.obs.metrics import metrics
+
+    fam = metrics._families.get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for key, v in fam.samples.items():
+        labels = dict(key)
+        if all(labels.get(k) == want for k, want in match.items()):
+            total += v
+    return total
+
+
+def _schedule(args):
+    """One seeded op schedule, identical for both variants: mostly reads
+    over a small key set, with interleaved writes that move the freshness
+    frontier the reads are checked against."""
+    rng = random.Random(args.seed)
+    keys = [f"GEO-{i}" for i in range(args.keys)]
+    ops = []
+    for i in range(args.reads):
+        key = keys[rng.randrange(len(keys))]
+        if rng.random() < args.p_write:
+            ops.append(("w", key, f"{key}@{i}"))
+        ops.append(("r", key, None))
+    return keys, ops
+
+
+def _p95_ms(latencies: list) -> float:
+    ordered = sorted(latencies)
+    return ordered[int(0.95 * (len(ordered) - 1))] * 1e3
+
+
+async def _drive(args, keys, ops, leased: bool) -> dict:
+    from dds_tpu.core.chaos import ChaosNet
+    from dds_tpu.core.transport import InMemoryNet
+    from dds_tpu.geo import wan
+    from dds_tpu.shard import build_constellation
+
+    regions = ["r0", "r1", "r2"]
+    net = ChaosNet(InMemoryNet(), seed=args.seed + 7)
+    wan.apply_profiles(net, wan.mesh(regions, args.preset),
+                       scale=args.scale)
+    const = build_constellation(
+        net, shard_count=2, vnodes_per_group=8, seed=args.seed,
+        n_active=3, n_sentinent=0, quorum=2,
+        regions=regions, placement="span",
+        lease_ttl=(args.lease_ttl if leased else 0.0),
+        client_region=("r0" if leased else ""),
+    )
+    r = const.router
+    served0 = _metric_sum("dds_geo_local_reads_total", result="served")
+    fell0 = _metric_sum("dds_geo_local_read_fallbacks_total")
+
+    last: dict[str, str] = {}
+    for k in keys:
+        await r.write_set(k, [f"{k}@preload"])
+        last[k] = f"{k}@preload"
+
+    lat, stale, reads_done = [], 0, 0
+    revoke_at = args.reads // 2
+    try:
+        for op, key, value in ops:
+            if op == "w":
+                await r.write_set(key, [value])
+                last[key] = value
+                continue
+            if leased and reads_done == revoke_at:
+                # the mid-run rug-pull: every group's table drops the r0
+                # lease, so the client's next token is refused and reads
+                # degrade to the full quorum until a fresh grant lands
+                for g in const.groups:
+                    if g.lease_table is not None:
+                        g.lease_table.revoke("r0")
+            t0 = time.perf_counter()
+            got = await r.fetch_set(key)
+            lat.append(time.perf_counter() - t0)
+            reads_done += 1
+            if got != [last[key]]:
+                stale += 1
+    finally:
+        await const.stop()
+        await net.quiesce()
+
+    return {
+        "p95_ms": _p95_ms(lat),
+        "reads": reads_done,
+        "stale": stale,
+        "leased_reads": int(
+            _metric_sum("dds_geo_local_reads_total", result="served")
+            - served0),
+        "fallbacks": int(
+            _metric_sum("dds_geo_local_read_fallbacks_total") - fell0),
+    }
+
+
+def main(argv=None) -> list:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--reads", type=int, default=96,
+                    help="reads per variant (writes ride on top)")
+    ap.add_argument("--keys", type=int, default=6,
+                    help="distinct keys in the schedule")
+    ap.add_argument("--p-write", type=float, default=0.15,
+                    help="probability a read is preceded by a fresh write")
+    ap.add_argument("--preset", default="wan-100",
+                    choices=["wan-100", "wan-200", "wan-300"],
+                    help="WAN RTT preset for every cross-region pair")
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="multiplier on WAN delays (CI-friendly shrink)")
+    ap.add_argument("--lease-ttl", type=float, default=2.0,
+                    help="read-local lease TTL for the leased variant")
+    ap.add_argument("--seed", type=int, default=31)
+    args = ap.parse_args(argv)
+
+    keys, ops = _schedule(args)
+    local = asyncio.run(_drive(args, keys, ops, leased=True))
+    quorum = asyncio.run(_drive(args, keys, ops, leased=False))
+
+    ratio = quorum["p95_ms"] / max(local["p95_ms"], 1e-9)
+    row = emit(
+        "geo latency",
+        ratio,
+        "x",
+        ratio,
+        local_p95_ms=round(local["p95_ms"], 3),
+        quorum_p95_ms=round(quorum["p95_ms"], 3),
+        reads=local["reads"] + quorum["reads"],
+        leased_reads=local["leased_reads"],
+        fallbacks=local["fallbacks"],
+        revoked_mid_run=True,
+        stale_reads=local["stale"] + quorum["stale"],
+        wan_preset=args.preset,
+        wan_scale=args.scale,
+        keys=args.keys,
+        lease_ttl_s=args.lease_ttl,
+        seed=args.seed,
+    )
+    return [row]
+
+
+if __name__ == "__main__":
+    main()

@@ -20,8 +20,8 @@ def main(argv=None):
 
     from benchmarks import (analytics_matvec, audit_cost, autoscale_goodput,
                             bft_sum, crossover, decrypt_throughput,
-                            encrypt_modexp, fleet_obs_overhead, mixed,
-                            multihost_load, overload_goodput, product,
+                            encrypt_modexp, fleet_obs_overhead, geo_latency,
+                            mixed, multihost_load, overload_goodput, product,
                             put_concurrency, resident_fold, search_latency,
                             shard_scaling, sweep)
 
@@ -56,6 +56,9 @@ def main(argv=None):
         )
         rows += search_latency.main(["--keys", "32", "--repeats", "2"])
         rows += autoscale_goodput.main(["--phase", "0.8", "--tail", "0.6"])
+        rows += geo_latency.main(
+            ["--reads", "24", "--keys", "4", "--scale", "0.05"]
+        )
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -74,6 +77,7 @@ def main(argv=None):
         rows += decrypt_throughput.main([])
         rows += search_latency.main([])
         rows += autoscale_goodput.main([])
+        rows += geo_latency.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

@@ -400,6 +400,46 @@ def _check_autoscale_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_geo_records(root: str = REPO) -> dict:
+    """Validate `geo latency` rows (benchmarks/geo_latency.py): positive
+    read-local-vs-quorum speedup and a detail block proving where the
+    speedup came from — both p95s, a leased-read count, the mid-run
+    revocation flag (the degradation path the record exists to cover),
+    ZERO stale reads (a leased read that trailed an acked write would
+    make the latency win meaningless), and a named WAN preset so the
+    schedule is reproducible. Same malformed contract: exit 2."""
+    presets = {"wan-100", "wan-200", "wan-300"}
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("geo latency")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("local_p95_ms"), (int, float))
+            and detail["local_p95_ms"] > 0
+            and isinstance(detail.get("quorum_p95_ms"), (int, float))
+            and detail["quorum_p95_ms"] > 0
+            and isinstance(detail.get("reads"), int) and detail["reads"] > 0
+            and isinstance(detail.get("leased_reads"), int)
+            and detail["leased_reads"] > 0
+            and isinstance(detail.get("fallbacks"), int)
+            and detail["fallbacks"] >= 0
+            and detail.get("revoked_mid_run") is True
+            and detail.get("stale_reads") == 0
+            and detail.get("wan_preset") in presets
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed geo-latency record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _load_fresh(path: str) -> dict:
     """A stats JSON: either the baseline schema or a bare kernels dict."""
     with open(path) as f:
@@ -448,6 +488,7 @@ def main(argv=None) -> int:
             decrypt = _check_decrypt_records()
             search = _check_search_records()
             autoscale = _check_autoscale_records()
+            geo = _check_geo_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -464,6 +505,7 @@ def main(argv=None) -> int:
             "decrypt_rows": decrypt["rows"],
             "search_rows": search["rows"],
             "autoscale_rows": autoscale["rows"],
+            "geo_rows": geo["rows"],
         }))
         return 0
 

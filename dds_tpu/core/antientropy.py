@@ -147,8 +147,17 @@ class AntiEntropy:
         self._rng = random.Random()
         self._task: Optional[asyncio.Task] = None
         self._pending: dict[int, asyncio.Future] = {}
+        # Atlas cross-region pairing: endpoint -> region labels, a bias
+        # toward cross-region pulls (the links where divergence actually
+        # accumulates after a WAN partition), and extra de-synchronising
+        # jitter ahead of a cross-region round so a whole region's loops
+        # never dogpile one WAN link at once
+        self.regions: dict = {}
+        self.cross_region_bias = 0.5
+        self.cross_jitter = 0.0
         # observability surface, exported via /health + scrape-time gauges
         self.rounds = 0
+        self.cross_rounds = 0
         self.repaired_total = 0
         self.last_divergence = 0   # divergent buckets seen in the last round
         self.last_sync: float | None = None  # monotonic ts of last completed round
@@ -156,7 +165,10 @@ class AntiEntropy:
     def configure(self, interval: float | None = None,
                   jitter: float | None = None,
                   sync_timeout: float | None = None,
-                  rng: random.Random | None = None) -> None:
+                  rng: random.Random | None = None,
+                  regions: dict | None = None,
+                  cross_region_bias: float | None = None,
+                  cross_jitter: float | None = None) -> None:
         if interval is not None:
             self.interval = interval
         if jitter is not None:
@@ -165,6 +177,33 @@ class AntiEntropy:
             self.sync_timeout = sync_timeout
         if rng is not None:
             self._rng = rng
+        if regions is not None:
+            self.regions = dict(regions)
+        if cross_region_bias is not None:
+            self.cross_region_bias = cross_region_bias
+        if cross_jitter is not None:
+            self.cross_jitter = cross_jitter
+
+    # -------------------------------------------------------- peer selection
+
+    def _region_of(self, endpoint: str) -> str:
+        return self.regions.get(
+            endpoint, self.regions.get(endpoint.rsplit("/", 1)[-1], ""))
+
+    def _pick_peer(self, peers: list[str]) -> tuple[str, bool]:
+        """(peer, is_cross_region). Geo-unaware fabrics draw uniformly;
+        geo-aware ones split peers by region and pull cross-region with
+        probability `cross_region_bias` — all draws come from the one
+        seeded rng, so a seeded fleet pairs identically every run."""
+        my_region = self._region_of(self.node.addr)
+        if not self.regions or not my_region:
+            return self._rng.choice(peers), False
+        local = [p for p in peers if self._region_of(p) == my_region]
+        remote = [p for p in peers if self._region_of(p) != my_region]
+        if remote and (not local
+                       or self._rng.random() < self.cross_region_bias):
+            return self._rng.choice(remote), True
+        return self._rng.choice(local or peers), False
 
     # ------------------------------------------------------------ lifecycle
 
@@ -194,7 +233,11 @@ class AntiEntropy:
             peers = [p for p in self.node.all_replicas if p != self.node.addr]
             if not peers:
                 continue
-            peer = self._rng.choice(peers)
+            peer, cross = self._pick_peer(peers)
+            if cross:
+                self.cross_rounds += 1
+                if self.cross_jitter > 0:
+                    await asyncio.sleep(self._rng.uniform(0, self.cross_jitter))
             try:
                 await self.sync_once(peer)
             except asyncio.TimeoutError:
@@ -407,6 +450,7 @@ class AntiEntropy:
         )
         return {
             "rounds": self.rounds,
+            "cross_region_rounds": self.cross_rounds,
             "repaired_keys": self.repaired_total,
             "divergent_buckets": self.last_divergence,
             "last_sync_age": age,

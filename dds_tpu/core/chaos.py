@@ -103,6 +103,12 @@ class ChaosNet(Transport):
         # (src_name, dest_name) -> LinkFaults, or dest_name -> LinkFaults;
         # the pair key wins over the dest key, which wins over the default
         self.links: dict = {}
+        # WAN topology: bare endpoint name -> region label, and
+        # (src_region, dest_region) -> LinkFaults. Resolution order per
+        # send is pair > dest > region-pair > default, so a surgical
+        # per-link override still beats the blanket WAN matrix
+        self.regions: dict[str, str] = {}
+        self.region_links: dict = {}
         self.partitions: list[Partition] = []
         # (seq, src, dest, msg type, action) — the deterministic fault trace
         self.trace: list[tuple] = []
@@ -148,8 +154,42 @@ class ChaosNet(Transport):
         self.links[(a, b)] = faults
         self.links[(b, a)] = faults
 
+    def set_regions(self, mapping: dict) -> None:
+        """Assign endpoints (bare names) to named regions. Merges into the
+        existing assignment so groups can be labeled incrementally."""
+        self.regions.update({_name(k): v for k, v in mapping.items()})
+
+    def region_of(self, addr: str) -> str:
+        """The endpoint's region label ("" when unassigned)."""
+        return self.regions.get(_name(addr), "")
+
+    def set_region_link(self, src_region: str, dest_region: str,
+                        faults: LinkFaults) -> None:
+        """Fault every link from `src_region` into `dest_region`. One-way:
+        call twice (or use geo.wan.apply_profile) for a symmetric WAN."""
+        self.region_links[(src_region, dest_region)] = faults
+
+    def region_members(self, region: str) -> list[str]:
+        """Bare endpoint names currently assigned to `region`, sorted."""
+        return sorted(n for n, r in self.regions.items() if r == region)
+
+    def region_partition(
+        self,
+        region: str,
+        symmetric: bool = True,
+        duration: Optional[float] = None,
+    ) -> Partition:
+        """Cut an entire region off from the rest of the fleet — the
+        region-death primitive. Asymmetric cuts only traffic LEAVING the
+        region (its members still hear the world but cannot answer)."""
+        members = self.region_members(region)
+        if not members:
+            raise ValueError(f"region {region!r} has no registered endpoints")
+        return self.partition(members, symmetric=symmetric, duration=duration)
+
     def clear_faults(self) -> None:
         self.links.clear()
+        self.region_links.clear()
         self.default_faults = LinkFaults()
 
     def partition(
@@ -190,7 +230,15 @@ class ChaosNet(Transport):
 
     def _faults_for(self, src: str, dest: str) -> LinkFaults:
         s, d = _name(src), _name(dest)
-        return self.links.get((s, d)) or self.links.get(d) or self.default_faults
+        explicit = self.links.get((s, d)) or self.links.get(d)
+        if explicit is not None:
+            return explicit
+        if self.region_links:
+            rp = self.region_links.get(
+                (self.regions.get(s, ""), self.regions.get(d, "")))
+            if rp is not None:
+                return rp
+        return self.default_faults
 
     def _note(self, src: str, dest: str, kind: str, action: str) -> None:
         self.trace.append((self._seq, _name(src), _name(dest), kind, action))

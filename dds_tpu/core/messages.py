@@ -483,6 +483,81 @@ class ShardPruned:
 
 
 # --------------------------------------------------------------------------
+# Atlas geo plane: read leases + region-local reads (dds_tpu/geo)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class LeaseRequest:
+    """Proxy -> the replica homed in the proxy's region: grant (or renew)
+    the region's read lease on yourself for `ttl` seconds. Signed with
+    the ABD MAC over the (region, ttl) manifest so only quorum members /
+    secret holders can move the group into pinned-quorum geometry (a
+    forged grant would be a free availability attack: every quorum
+    would wait on the forger's chosen replica)."""
+
+    region: str
+    ttl: float
+    nonce: int
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class LeaseGrant:
+    """Replica -> proxy: the lease is installed in the group's shared
+    LeaseTable (ok=True) or refused (ok=False: no table wired, or the
+    replica is not this region's designated holder). `token` is the
+    table-minted HMAC capability LocalRead must echo; `expires` is in
+    the GRANTING side's clock — the proxy derives its own renew horizon
+    from `ttl` it requested, never from a remote clock."""
+
+    region: str
+    replica: str
+    token: str
+    expires: float
+    ok: bool
+    nonce: int
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class LeaseRevoke:
+    """Admin/supervisor -> any group replica: drop `region`'s lease from
+    the shared table. Same manifest-MAC bar as LeaseRequest. The current
+    holder finds out the hard way (its next LocalRead is refused), which
+    is exactly the fallback path the client must survive anyway."""
+
+    region: str
+    nonce: int
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class LocalRead:
+    """Proxy -> lease-holding replica: answer `key` from local state
+    under the lease capability `token` — no quorum round. Only valid
+    while the table says (region, replica, token) is the active lease;
+    anything else is refused with ok=False so the proxy falls back to a
+    full cross-region quorum read instead of timing out."""
+
+    key: str
+    region: str
+    token: str
+    nonce: int
+    signature: bytes
+    epoch: int = -1
+
+
+@dataclass(frozen=True)
+class LocalReadReply:
+    tag: Optional[ABDTag]
+    key: str
+    value: Optional[DDSSet]
+    ok: bool
+    nonce: int
+    signature: bytes
+
+
+# --------------------------------------------------------------------------
 # Panopticon fleet telemetry (dds_tpu/obs/panopticon)
 # --------------------------------------------------------------------------
 
@@ -511,6 +586,10 @@ class TelemetryBatch:
     slo: dict
     dropped: int          # spool drops at the SOURCE since process start
     mac: bytes
+    # Atlas region label of the shipping process ("" = unplaced). Covered
+    # by the payload MAC like every other field; the collector surfaces
+    # it on federated metrics and incident correlation.
+    region: str = ""
 
 
 @dataclass(frozen=True)
@@ -561,6 +640,7 @@ _TYPES = {
         WrongShard, ShardMigrateBegin, ShardMigrateAck,
         ShardMapInstall, ShardMapActivate, ShardMapAck,
         ShardExportRequest, ShardExport, ShardPruneRequest, ShardPruned,
+        LeaseRequest, LeaseGrant, LeaseRevoke, LocalRead, LocalReadReply,
         TelemetryBatch, TelemetryAck,
     )
 }

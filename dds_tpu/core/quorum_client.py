@@ -80,6 +80,19 @@ class AbdClientConfig:
     # budget, the degraded try (which may close a breaker) proceeds as
     # before, so nothing heals slower.
     fast_fail_all_open: bool = True
+    # Atlas read-local leases (dds_tpu/geo): when enabled and an in-region
+    # replica is known, reads first try a single-hop LocalRead against the
+    # TTL-leased holder; any refusal, timeout, or validation failure drops
+    # the lease session and the read falls back to the full cross-region
+    # quorum path below — leases are a latency optimisation, never a
+    # correctness dependency.
+    lease_enabled: bool = False
+    region: str = ""  # this proxy's home region ("" = geo-unaware)
+    # replica addr (or bare name) -> region label, as placed by shard.fabric
+    replica_regions: Optional[dict] = None
+    lease_ttl: float = 2.0
+    lease_renew_margin: float = 0.5  # renew when lease remaining < margin
+    local_read_timeout: float = 0.75  # LocalRead budget before fallback
 
 
 class AbdClient:
@@ -107,6 +120,15 @@ class AbdClient:
         # stamped with it so replicas can fence stale routes. None = -1 =
         # unsharded (replicas without a shard state ignore the field).
         self.shard_epoch: Optional[callable] = None
+        # Atlas lease session: {"target", "replica", "token", "renew_at",
+        # "expires"} while we hold an in-region read lease, else None.
+        # Client-side expiry is measured from SEND time, so it is always
+        # conservative w.r.t. the holder's table clock.
+        self._lease: Optional[dict] = None
+        self._lease_retry_at = 0.0  # grant backoff after a refusal/timeout
+        # lease/local-read request nonce -> future (replies echo it)
+        self._pending_lease: dict[int, asyncio.Future] = {}
+        self._now = time.monotonic  # test hook (fake-clock schedules)
         net.register(addr, self.handle)
 
     async def handle(self, sender: str, msg) -> None:
@@ -131,6 +153,15 @@ class AbdClient:
                     fut.set_result(msg)
             elif msg.nonce in self._pending_tags:
                 self._on_wrong_shard_batch(sender, msg)
+            return
+        if isinstance(msg, (M.LeaseGrant, M.LocalReadReply)):
+            # correlate by REQUEST nonce (like TagBatchReply). Unmatched
+            # (late) lease replies are dropped HERE — they must never fall
+            # through to the junk-reply path and strike an honest replica
+            # that also coordinates an outstanding Envelope op.
+            entry = self._pending_lease.get(msg.nonce)
+            if entry is not None and not entry.done():
+                entry.set_result(msg)
             return
         if isinstance(msg, M.ActiveReplicas):
             if self.cfg.supervisor is not None and sender != self.cfg.supervisor:
@@ -338,6 +369,14 @@ class AbdClient:
         # and is never audited as a commit.
         cfg = self.cfg
         with tracer.span("abd.fetch") as span_meta:
+            # Atlas fast path: one hop to the in-region lease holder.
+            # Skipped when the caller steers coordinators (`exclude` means
+            # an audit wants an INDEPENDENT quorum read, not a lease echo);
+            # any miss falls through to the quorum round below.
+            if cfg.lease_enabled and not exclude:
+                local = await self._local_fetch(key, span_meta, deadline)
+                if local is not None:
+                    return local
             reply, coord, challenge = await self._ask(
                 M.IRead(key), nonce, sig, exclude, deadline, op="fetch"
             )
@@ -413,6 +452,185 @@ class AbdClient:
                 case _:
                     self._coord_failed(coord)
                     raise ByzUnknownReplyError(coord)
+
+    # ------------------------------------------------- Atlas read-local leases
+
+    def _local_replica(self) -> Optional[str]:
+        """The trusted in-region replica eligible to hold our read lease
+        (first in trusted order — deterministic for seeded fleets)."""
+        cfg = self.cfg
+        if not cfg.lease_enabled or not cfg.region or not cfg.replica_regions:
+            return None
+        for addr in self.replicas.get_trusted():
+            name = addr.rsplit("/", 1)[-1]
+            region = cfg.replica_regions.get(
+                addr, cfg.replica_regions.get(name, ""))
+            if region == cfg.region:
+                return addr
+        return None
+
+    def lease_state(self) -> Optional[dict]:
+        """Current lease session for /health: {replica, remaining} or None."""
+        lease = self._lease
+        if lease is None:
+            return None
+        remaining = lease["expires"] - self._now()
+        if remaining <= 0:
+            return None
+        return {"replica": lease["replica"], "region": self.cfg.region,
+                "remaining": round(remaining, 3)}
+
+    def invalidate_lease(self) -> None:
+        """Drop the lease session; the next read goes full-quorum (and may
+        re-acquire after the grant backoff)."""
+        self._lease = None
+
+    async def _ask_lease(self, target: str, msg, nonce: int, timeout: float):
+        """One lease-plane round trip, correlated by request nonce."""
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending_lease[nonce] = fut
+        try:
+            self.net.send(self.addr, target, msg)
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending_lease.pop(nonce, None)
+
+    async def _ensure_lease(self) -> Optional[dict]:
+        """Grant-or-renew the region read lease. None = no lease available
+        right now (no in-region replica, refusal, or inside the backoff)."""
+        cfg = self.cfg
+        lease, now = self._lease, self._now()
+        if lease is not None and now < lease["renew_at"]:
+            return lease
+        if lease is None and now < self._lease_retry_at:
+            return None
+        target = self._local_replica()
+        if target is None:
+            self._lease = None
+            return None
+        nonce = sigs.generate_nonce()
+        sig = sigs.manifest_signature(
+            cfg.abd_mac_secret, "lease-request",
+            {"region": cfg.region, "ttl": cfg.lease_ttl}, nonce)
+        sent_at = now
+        try:
+            grant = await self._ask_lease(
+                target, M.LeaseRequest(cfg.region, cfg.lease_ttl, nonce, sig),
+                nonce, cfg.local_read_timeout)
+        except asyncio.TimeoutError:
+            grant = None
+        if (
+            not isinstance(grant, M.LeaseGrant)
+            or not grant.ok
+            or grant.region != cfg.region
+            or not sigs.validate_manifest_signature(
+                cfg.abd_mac_secret, "lease-grant",
+                {"region": grant.region, "replica": grant.replica,
+                 "token": grant.token, "expires": grant.expires,
+                 "ok": grant.ok}, nonce, grant.signature)
+        ):
+            self._lease = None
+            self._lease_retry_at = self._now() + cfg.lease_renew_margin
+            metrics.inc(
+                "dds_geo_lease_failures_total", **self._mlabels(),
+                help="lease grant/renew attempts that were refused, "
+                     "timed out, or failed validation",
+            )
+            return None
+        # expiry measured from SEND time: always conservative vs the
+        # holder's own table clock, so we stop using the token strictly
+        # before the holder stops honouring it
+        self._lease = {
+            "target": target,
+            "replica": grant.replica,
+            "token": grant.token,
+            "renew_at": sent_at + cfg.lease_ttl - cfg.lease_renew_margin,
+            "expires": sent_at + cfg.lease_ttl,
+        }
+        return self._lease
+
+    async def _local_fetch(self, key: str, span_meta: dict,
+                           deadline: Optional[Deadline]):
+        """Lease fast path for one read: single hop to the in-region
+        holder. Returns (value, tag, holder) or None — None means "take
+        the full quorum path", never an error."""
+        cfg = self.cfg
+        lease = await self._ensure_lease()
+        if lease is None:
+            return None
+        timeout = cfg.local_read_timeout
+        if deadline is not None:
+            timeout = min(timeout, deadline.remaining())
+            if timeout <= 0:
+                return None
+        nonce = sigs.generate_nonce()
+        sig = sigs.proxy_signature(cfg.proxy_mac_secret, key, nonce,
+                                   ["local-read", cfg.region])
+        t0 = time.perf_counter()
+        try:
+            reply = await self._ask_lease(
+                lease["target"],
+                M.LocalRead(key, cfg.region, lease["token"], nonce, sig,
+                            epoch=self._epoch()),
+                nonce, timeout)
+        except asyncio.TimeoutError:
+            # holder unreachable: drop the session (the table-side TTL
+            # unpins the group's quorums on its own) and go full-quorum
+            self._lease = None
+            self._lease_retry_at = self._now() + cfg.lease_renew_margin
+            metrics.inc(
+                "dds_geo_local_read_fallbacks_total",
+                **self._mlabels(reason="timeout"),
+                help="lease reads that fell back to a full quorum round",
+            )
+            return None
+        if (
+            not isinstance(reply, M.LocalReadReply)
+            or reply.key != key
+            or not sigs.validate_proxy_signature(
+                cfg.proxy_mac_secret, reply.key, reply.nonce, reply.signature,
+                [reply.ok, reply.value,
+                 sigs.tag_payload(reply.tag) if reply.tag is not None
+                 else None])
+        ):
+            # a garbled/forged local reply is cryptographic evidence like
+            # any other protocol violation
+            self.replicas.increment_suspicion(lease["target"])
+            self._lease = None
+            metrics.inc(
+                "dds_geo_local_read_fallbacks_total",
+                **self._mlabels(reason="invalid"),
+                help="lease reads that fell back to a full quorum round",
+            )
+            return None
+        if not reply.ok:
+            # typed refusal: the lease was revoked/expired table-side (or
+            # the key is fenced) — degrade to full quorum immediately
+            self._lease = None
+            self._lease_retry_at = self._now() + cfg.lease_renew_margin
+            metrics.inc(
+                "dds_geo_local_read_fallbacks_total",
+                **self._mlabels(reason="refused"),
+                help="lease reads that fell back to a full quorum round",
+            )
+            return None
+        metrics.observe(
+            "dds_quorum_rtt_seconds", time.perf_counter() - t0,
+            **self._mlabels(op="local_read"),
+            help="proxy->coordinator quorum round-trip time",
+        )
+        span_meta["ok"] = True
+        span_meta["op"] = "read"
+        span_meta["key"] = key
+        # Watchtower reads these two: `lease` switches the span from the
+        # strict quorum-intersection bound to the documented lease-window
+        # invariant, `replica` is what the lease_lookup is checked against
+        span_meta["lease"] = True
+        span_meta["replica"] = lease["replica"]
+        if reply.tag is not None:
+            span_meta["seq"] = reply.tag.seq
+            span_meta["tag_id"] = reply.tag.id
+        return reply.value, reply.tag, lease["target"]
 
     def _on_wrong_shard_batch(self, sender: str, msg: M.WrongShard) -> None:
         """A replica fenced a ReadTagBatch: the whole round fails with

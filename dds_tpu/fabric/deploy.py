@@ -345,8 +345,10 @@ def _identify(cfg, namer, role: str, shard: str = "") -> dict:
     identity = {"host": host, "role": role}
     if shard:
         identity["shard"] = shard
+    if cfg.fabric.region:
+        identity["region"] = cfg.fabric.region
     flight.configure(identity=identity)
-    process_info(role=role, shard=shard)
+    process_info(role=role, shard=shard, region=cfg.fabric.region)
     return identity
 
 
@@ -366,6 +368,7 @@ def _start_shipper(cfg, net, namer, stoppables, *, role: str,
         host=namer("_id").rsplit("/", 1)[0],
         role=role,
         shard=shard,
+        region=cfg.fabric.region,
         spool_max=fl.spool_max,
         batch_max=fl.batch_max,
         flush_interval=fl.flush_interval,
@@ -557,6 +560,14 @@ async def _launch_group(cfg, net, stoppables, ssl_server, ssl_client,
         smap = newer
 
     state = ShardState(gid, smap, secret)
+    geo_kw = {}
+    if cfg.geo.enabled and cfg.fabric.region:
+        # Atlas on Meridian: a group process is wholly homed in its
+        # host's [fabric] region — label its replicas and install the
+        # lease table so region-local proxies can hold read leases
+        geo_kw = dict(regions=[cfg.fabric.region],
+                      home_region=cfg.fabric.region,
+                      lease_ttl=cfg.geo.lease_ttl)
     group = build_group(
         net, gid, state,
         n_active=sh.replicas_per_group,
@@ -566,6 +577,7 @@ async def _launch_group(cfg, net, stoppables, ssl_server, ssl_client,
         rcfg=rcfg, sup_cfg=sup_cfg, abd_cfg=abd_cfg,
         chaos=cfg.attacks.chaos_enabled,
         namer=namer,
+        **geo_kw,
     )
     if cfg.recovery.enabled:
         group.supervisor.start()
@@ -662,6 +674,7 @@ async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
             secret=_fleet_secret(cfg),
             host=namer("_id").rsplit("/", 1)[0],
             role="proxy",
+            region=cfg.fabric.region,
             stitch_window=cfg.obs.fleet.stitch_window,
             staleness=cfg.obs.fleet.staleness,
             slo=slo_engine,

@@ -58,6 +58,7 @@ class Helmsman:
         breaker_census=None,         # () -> (trusted_total, [open ETAs])
         pool_pressure=None,          # () -> 0..1 resident-pool occupancy
         source_ages=None,            # () -> {gid: seconds since heartbeat}
+        regions=None,                # () -> {gid: home region} (Atlas)
         # ---- actions (async callables) ----
         split=None,                  # async (gid) -> None
         merge=None,                  # async (gid) -> None
@@ -85,6 +86,8 @@ class Helmsman:
         self._breaker_census = breaker_census or (lambda: (0, []))
         self._pool_pressure = pool_pressure
         self._source_ages = source_ages
+        self._regions = regions
+        self._regions_down: set = set()  # regions currently declared dead
         self._split = split
         self._merge = merge
         self._promote = promote
@@ -213,19 +216,55 @@ class Helmsman:
                   "open_breakers": len(etas), "pool_pressure": round(pool, 3)}
         return bool(alerts or shed > 0 or pool >= 0.9), detail
 
+    def _dead_regions(self, ages: dict, known: set) -> dict:
+        """Atlas region-death detection: regions whose EVERY homed group's
+        heartbeat has aged out at once. Returns {gid: home region} labels
+        for promotion detail; declares/clears `region_down` incidents as
+        the region dies and heals (a single dead group in a live region
+        is a process crash, not a region event)."""
+        if self._regions is None:
+            return {}
+        labels = {g: r for g, r in dict(self._regions()).items() if r}
+        stale = {g for g, a in ages.items()
+                 if g in known and a >= self.heartbeat_timeout}
+        for region in sorted(set(labels.values())):
+            homed = {g for g, r in labels.items()
+                     if r == region and g in known}
+            if homed and homed <= stale:
+                if region not in self._regions_down:
+                    self._regions_down.add(region)
+                    self._note("region_down", region=region,
+                               groups=sorted(homed))
+                    metrics.inc(
+                        "dds_helmsman_region_down_total", region=region,
+                        help="whole-region heartbeat losses declared by "
+                             "Helmsman",
+                    )
+            else:
+                self._regions_down.discard(region)
+        return labels
+
     async def _check_liveness(self) -> str | None:
-        """Dead-group takeover — runs even when pinned."""
+        """Dead-group takeover — runs even when pinned. Region-aware
+        (Atlas): a whole region aging out is declared `region_down`, and
+        each of its groups is promoted like any dead group — the fabric's
+        promote prefers a standby homed where the dead group lived, which
+        for a dead region means the cross-region takeover the drill
+        exercises."""
         if self._source_ages is None or self._promote is None:
             return None
         now = self._clock()
         known = set(self._last_counts)
-        for gid, age in dict(self._source_ages()).items():
+        ages = dict(self._source_ages())
+        labels = self._dead_regions(ages, known)
+        for gid, age in ages.items():
             if gid not in known or age < self.heartbeat_timeout:
                 continue
             if now - self._promoted.get(gid, -1e18) < 2 * self.cooldown:
                 continue  # takeover already launched; give it time
             self._promoted[gid] = now
-            self._note("promote", dead=gid, heartbeat_age=round(age, 1))
+            self._note("promote", dead=gid, heartbeat_age=round(age, 1),
+                       region=labels.get(gid, ""))
             try:
                 await self._promote(gid)
                 self._cooldown_until = now + self.cooldown

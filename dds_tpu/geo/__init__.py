@@ -1,0 +1,34 @@
+"""Atlas: geo-distributed constellation plane.
+
+Region-aware placement (signed region labels on shard maps, per-replica
+region spread inside a group), WAN ChaosNet profiles (named per-region
+link matrices with 100-300 ms RTT presets), TTL-leased read-local quorum
+geometry layered on BFT-ABD, and cross-region convergence/failover glue.
+
+The lease design follows the quorum-lease construction: while a region
+holds a read lease on a group, EVERY quorum the group's coordinators
+close (write acks, read value rounds) must additionally include the
+lease-holding replicas. A leased replica therefore stores every acked
+write before its ack exists, so a local read served under an active
+lease can never return a value older than the last acked cross-region
+write. The price is availability, not safety: a dead lease holder
+stalls quorums for at most one lease TTL, after which expiry restores
+plain quorum geometry. The one residual window — a lease granted while
+a round that already closed its quorum is still in flight — is bounded
+by a single round and is audited explicitly by the Watchtower's
+lease-window invariant instead of being silently exempt.
+"""
+
+from dds_tpu.geo.lease import LeaseTable, ReadLease
+from dds_tpu.geo.placement import group_regions, spread
+from dds_tpu.geo.wan import WAN_PRESETS, apply_profiles, faults_from_spec
+
+__all__ = [
+    "LeaseTable",
+    "ReadLease",
+    "WAN_PRESETS",
+    "apply_profiles",
+    "faults_from_spec",
+    "group_regions",
+    "spread",
+]

@@ -1,0 +1,41 @@
+"""Region-aware placement helpers.
+
+Two granularities, matching how the fleet is actually laid out:
+
+- `spread`: one GROUP's replicas distributed round-robin across the
+  region list — the span-group shape read-local leases need (every
+  region holds a replica of every span group);
+- `group_regions`: whole groups homed per region round-robin — the
+  shape Helmsman's region-aware promotion reasons about (a region dying
+  takes its homed groups' heartbeats with it).
+
+Both are deterministic in input order so a seeded fleet build places
+identically every run.
+"""
+
+from __future__ import annotations
+
+
+def spread(endpoints: list, regions: list[str]) -> dict[str, str]:
+    """endpoint -> region, round-robin in endpoint order."""
+    if not regions:
+        return {}
+    return {e: regions[i % len(regions)] for i, e in enumerate(endpoints)}
+
+
+def group_regions(gids: list, regions: list[str]) -> dict[str, str]:
+    """gid -> home region, round-robin in gid order."""
+    if not regions:
+        return {}
+    return {g: regions[i % len(regions)] for i, g in enumerate(gids)}
+
+
+def prefer(candidates: list, region_of: dict, region: str) -> list:
+    """Candidates reordered: `region` natives first, then the rest —
+    input order preserved within each half (stable, so seeded builds
+    pick deterministically). The standby-acquisition ordering."""
+    if not region:
+        return list(candidates)
+    native = [c for c in candidates if region_of.get(c, "") == region]
+    other = [c for c in candidates if region_of.get(c, "") != region]
+    return native + other

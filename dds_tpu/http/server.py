@@ -99,6 +99,10 @@ _ADMISSION_EXEMPT = frozenset({"health", "metrics", "slo", "shards",
 class ProxyConfig:
     host: str = "127.0.0.1"
     port: int = 8443
+    # Atlas ([fabric] region): the region this proxy process runs in,
+    # surfaced on /health so operators (and the geo drills) see which
+    # regional vantage a probe answers from
+    region: str = ""
     # Deadline-propagated retry (utils/retry): every request gets ONE
     # overall budget minted at the REST edge; quorum attempts + exponential
     # full-jitter backoffs retry inside it, per-attempt timeouts shrink to
@@ -1504,6 +1508,8 @@ class DDSRestServer:
                     "stored_keys": len(self.stored_keys),
                     "request_budget": self.cfg.request_budget,
                 }
+                if self.cfg.region:
+                    health["region"] = self.cfg.region
                 if shards is not None:
                     health["shards"] = shards
                     health["shard_epoch"] = self._shards.epoch

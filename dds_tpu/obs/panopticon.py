@@ -93,16 +93,18 @@ _LOOSE_EVENTS = frozenset({
 _START_TS = time.time()
 
 
-def process_info(registry=None, *, role: str, shard: str = "") -> None:
+def process_info(registry=None, *, role: str, shard: str = "",
+                 region: str = "") -> None:
     """Publish the per-process identity gauge every `/metrics` carries:
-    `dds_process_info{role,shard,pid,start_ts,version} 1`. Federated
-    scrapes and incident correlation attribute sources by it."""
+    `dds_process_info{role,shard,region,pid,start_ts,version} 1`.
+    Federated scrapes and incident correlation attribute sources by it."""
     from dds_tpu import __version__
 
     reg = registry if registry is not None else default_metrics
     reg.set(
         "dds_process_info", 1.0,
-        role=role, shard=shard or "-", pid=str(os.getpid()),
+        role=role, shard=shard or "-", region=region or "-",
+        pid=str(os.getpid()),
         start_ts=f"{_START_TS:.3f}", version=__version__,
         help="process identity (value is always 1; the labels carry it)",
     )
@@ -110,11 +112,13 @@ def process_info(registry=None, *, role: str, shard: str = "") -> None:
 
 def batch_mac(secret: bytes, host: str, role: str, shard: str, seq: int,
               ts: float, spans: list, incidents: list, metrics_text: str,
-              slo: dict, dropped: int) -> bytes:
-    """HMAC-SHA256 over the canonical JSON of a batch payload."""
+              slo: dict, dropped: int, region: str = "") -> bytes:
+    """HMAC-SHA256 over the canonical JSON of a batch payload. The Atlas
+    `region` label is covered too — a forged region would let a
+    compromised source masquerade into another region's federated view."""
     body = json.dumps(
         [host, role, shard, seq, ts, spans, incidents, metrics_text, slo,
-         dropped],
+         dropped, region],
         sort_keys=True, separators=(",", ":"),
     ).encode()
     return hmac_mod.new(secret, body, hashlib.sha256).digest()
@@ -160,7 +164,8 @@ class SpanShipper:
     MAX_ACTIVE = 1024
 
     def __init__(self, net, *, collector: str, secret: bytes, host: str,
-                 role: str, shard: str = "", spool_max: int = 256,
+                 role: str, shard: str = "", region: str = "",
+                 spool_max: int = 256,
                  batch_max: int = 32, flush_interval: float = 0.25,
                  flight_dir: str = "", slo=None, tracer: Tracer | None = None,
                  registry=None):
@@ -169,6 +174,7 @@ class SpanShipper:
         self.collector_addr = f"{collector}/{COLLECTOR_ENDPOINT}"
         self.secret = secret
         self.host, self.role, self.shard = host, role, shard
+        self.region = region  # Atlas: [fabric] region, MAC-covered
         self.spool_max = max(1, spool_max)
         self.batch_max = max(1, batch_max)
         self.flush_interval = max(0.01, flush_interval)
@@ -332,12 +338,12 @@ class SpanShipper:
         slo = self.slo.report() if self.slo is not None else {}
         mac = batch_mac(self.secret, self.host, self.role, self.shard,
                         self._seq, ts, spans, incidents, metrics_text, slo,
-                        self._dropped)
+                        self._dropped, self.region)
         batch = M.TelemetryBatch(
             host=self.host, role=self.role, shard=self.shard, seq=self._seq,
             ts=ts, spans=spans, incidents=incidents,
             metrics_text=metrics_text, slo=slo, dropped=self._dropped,
-            mac=mac,
+            mac=mac, region=self.region,
         )
         self.net.send(self.src_addr, self.collector_addr, batch)
         self.metrics.inc("dds_fleet_ship_batches_total",
@@ -473,12 +479,14 @@ class FleetCollector:
     DONE_LRU = 2048
 
     def __init__(self, net, *, secret: bytes, host: str, role: str = "proxy",
-                 stitch_window: float = 1.0, staleness: float = 10.0,
+                 region: str = "", stitch_window: float = 1.0,
+                 staleness: float = 10.0,
                  watchtower=None, tracer: Tracer | None = None,
                  registry=None, slo=None):
         self.net = net
         self.secret = secret
         self.host, self.role = host, role
+        self.region = region  # Atlas: the collector process's own region
         self.stitch_window = max(0.0, stitch_window)
         self.staleness = staleness
         if watchtower is None:
@@ -569,7 +577,8 @@ class FleetCollector:
             return
         expect = batch_mac(self.secret, msg.host, msg.role, msg.shard,
                            msg.seq, msg.ts, msg.spans, msg.incidents,
-                           msg.metrics_text, msg.slo, msg.dropped)
+                           msg.metrics_text, msg.slo, msg.dropped,
+                           getattr(msg, "region", ""))
         if not hmac_mod.compare_digest(msg.mac, expect):
             self.metrics.inc(
                 "dds_fleet_collect_rejected_total", reason="mac",
@@ -581,6 +590,7 @@ class FleetCollector:
             return
         self._sources[msg.host] = {
             "role": msg.role, "shard": msg.shard, "ts": msg.ts,
+            "region": getattr(msg, "region", ""),
             "mono": time.monotonic(), "seq": msg.seq,
             "metrics_text": msg.metrics_text, "slo": msg.slo,
             "dropped": msg.dropped,
@@ -660,6 +670,7 @@ class FleetCollector:
         now = time.monotonic()
         rows = [{
             "host": self.host, "role": self.role, "shard": "",
+            "region": self.region,
             "age_s": 0.0, "stale": False,
             "metrics_text": self.metrics.render(),
             "slo": self.slo.report() if self.slo is not None else {},
@@ -669,6 +680,7 @@ class FleetCollector:
             age = now - src["mono"]
             rows.append({
                 "host": host, "role": src["role"], "shard": src["shard"],
+                "region": src.get("region", ""),
                 "age_s": age,
                 "stale": bool(self.staleness and age > self.staleness),
                 "metrics_text": src["metrics_text"], "slo": src["slo"],
@@ -704,6 +716,8 @@ class FleetCollector:
             labels = {"host": r["host"], "role": r["role"]}
             if r["shard"]:
                 labels["shard"] = r["shard"]
+            if r.get("region"):
+                labels["region"] = r["region"]
             sources.append({"labels": labels, "text": r["metrics_text"]})
         doc = merge_expositions(sources)
         extra = [
@@ -747,6 +761,7 @@ class FleetCollector:
         for r in rows:
             hosts[r["host"]] = {
                 "role": r["role"], "shard": r["shard"],
+                "region": r.get("region", ""),
                 "age_s": round(r["age_s"], 3), "stale": r["stale"],
                 "dropped": r["dropped"],
                 "slo": r["slo"],

@@ -32,6 +32,20 @@ rests on:
 - `suspicion_legality` — a coordinator that accumulated 3 protocol
   violations is permanently excluded; any op committed through it AFTER
   the third strike is a violation.
+- `lease_intersection` — Atlas lease reads (spans tagged `lease=True`)
+  legally bypass the quorum-intersection bound: their freshness rests on
+  the holder-pinned quorum geometry instead (while a lease is active,
+  every quorum its group closes includes the holder — dds_tpu/geo). The
+  auditable residue is that the serving replica actually HOLDS a lease:
+  with a configured `lease_lookup`, a lease-tagged read served by a
+  non-holder is a forged local read and a violation.
+- `lease_staleness` — the documented weaker bound for lease reads: a
+  lease read that returns a tag older than a write known-completed
+  before it started is REPORTED under this invariant (the residual
+  grant-instant window, bounded by one in-flight round + lease TTL by
+  construction), never as `tag_monotonicity`/`read_sees_latest` — so a
+  geo drill can assert "only the documented lease-window verdicts, and
+  nothing else".
 
 Every violation becomes a structured `Verdict`, increments
 `dds_audit_violations_total{invariant=...}`, and files a flight-recorder
@@ -99,6 +113,8 @@ class _Op:
     end: float
     trace_id: str | None
     coordinator: str = ""
+    lease: bool = False     # Atlas read-local lease fast path
+    replica: str = ""       # the lease holder that served it
 
 
 class Watchtower:
@@ -144,6 +160,7 @@ class Watchtower:
         n_replicas: int | None = None,
         check_quorum: bool | None = None,
         group_geometry: dict | None = None,
+        lease_lookup=None,
     ) -> None:
         """Late wiring from a deployment config (run.launch).
 
@@ -151,7 +168,15 @@ class Watchtower:
         prefix, e.g. "s0" for "s0-replica-3") to that group's (quorum
         size, active replica count): a sharded deployment's ops are
         audited against the geometry of the GROUP whose replicas served
-        them, not a global q/n — heterogeneous groups audit correctly."""
+        them, not a global q/n — heterogeneous groups audit correctly.
+
+        `lease_lookup` (Atlas) is a callable `replica_name -> bool`
+        answering "does this replica hold an active read lease?" — the
+        ground truth the `lease_intersection` invariant audits lease-
+        tagged reads against (typically a closure over the fabric's
+        per-group LeaseTables). Audit runs at trace completion, so keep
+        the lookup tolerant of grants that expired moments ago (renewing
+        sessions keep holders stable in practice)."""
         if quorum_size is not None:
             self.quorum_size = quorum_size
         if n_replicas is not None:
@@ -162,6 +187,10 @@ class Watchtower:
             self.group_geometry = dict(group_geometry)
         elif not hasattr(self, "group_geometry"):
             self.group_geometry = {}
+        if lease_lookup is not None:
+            self.lease_lookup = lease_lookup
+        elif not hasattr(self, "lease_lookup"):
+            self.lease_lookup = None
         # quorum-intersection bound: any two quorums of size q out of n
         # replicas share >= 2q - n members (>= f+1 for honest quorums)
         self.intersection = max(1, 2 * self.quorum_size - self.n_replicas)
@@ -308,7 +337,11 @@ class Watchtower:
                 op = self._distill_op(r)
                 if op is not None:
                     ops.append(op)
-                if self.check_quorum:
+                if r.meta.get("lease"):
+                    # a lease read is a single hop — no quorum subtree to
+                    # intersect; audit the weaker lease invariant instead
+                    self._check_lease_intersection(r)
+                elif self.check_quorum:
                     self._check_quorum_intersection(r, children)
         for r in records:
             if r.kind == "event" and r.name == "audit.repair":
@@ -324,11 +357,20 @@ class Watchtower:
                 w = last_write.get(op.key)
                 if w is not None and w.end <= op.start and op.tag < w.tag:
                     flagged = True
-                    self._violate(
-                        "read_sees_latest", op.trace_id,
-                        key=op.key, read_tag=list(op.tag),
-                        write_tag=list(w.tag), coordinator=op.coordinator,
-                    )
+                    if op.lease:
+                        # documented lease-window bound, not a BFT violation
+                        self._violate(
+                            "lease_staleness", op.trace_id,
+                            key=op.key, read_tag=list(op.tag),
+                            write_tag=list(w.tag), replica=op.replica,
+                            window="intra_trace",
+                        )
+                    else:
+                        self._violate(
+                            "read_sees_latest", op.trace_id,
+                            key=op.key, read_tag=list(op.tag),
+                            write_tag=list(w.tag), coordinator=op.coordinator,
+                        )
             self._check_key_history(op, already_flagged=flagged)
             self._check_suspicion_legality(op)
             if op.op == "write":
@@ -356,7 +398,30 @@ class Watchtower:
             end=end,
             trace_id=rec.trace_id,
             coordinator=str(rec.meta.get("coordinator", "")),
+            lease=bool(rec.meta.get("lease")),
+            replica=str(rec.meta.get("replica", "")),
         )
+
+    def _check_lease_intersection(self, op_span) -> None:
+        """Audit a lease-tagged read against the lease ground truth: the
+        serving replica must hold an active lease (AbdClient only marks
+        `lease=True` on the single-hop fast path, whose whole safety case
+        is the holder-pinned quorum geometry). Without a configured
+        `lease_lookup` there is no ground truth to check — the span is
+        merely exempted from the quorum-intersection bound."""
+        if self.lease_lookup is None:
+            return
+        replica = str(op_span.meta.get("replica", ""))
+        try:
+            holds = bool(self.lease_lookup(replica))
+        except Exception:  # noqa: BLE001 — a broken lookup must not drop audits
+            log.exception("lease_lookup failed for %r", replica)
+            return
+        if not holds:
+            self._violate(
+                "lease_intersection", op_span.trace_id,
+                key=op_span.meta.get("key"), replica=replica,
+            )
 
     def _check_quorum_intersection(self, op_span, children) -> None:
         """Phase participant sets over the op span's subtree: committed
@@ -416,6 +481,17 @@ class Watchtower:
             dup_mint = op.op == "write" and op.tag == h.tag
             if (stale or dup_mint) and not already_flagged:
                 already_flagged = True
+                if op.lease and stale:
+                    # the residual grant-instant window (dds_tpu/geo):
+                    # file it under the documented lease invariant so a
+                    # drill can distinguish it from a real BFT violation
+                    self._violate(
+                        "lease_staleness", op.trace_id,
+                        key=op.key, tag=list(op.tag),
+                        prior_tag=list(h.tag), prior_trace=h.trace_id,
+                        replica=op.replica, window="cross_trace",
+                    )
+                    continue
                 self._violate(
                     "tag_monotonicity", op.trace_id,
                     key=op.key, op=op.op, tag=list(op.tag),

@@ -75,6 +75,16 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     cfg = cfg or DDSConfig()
     stoppables = []
 
+    # Atlas [retry]: the per-region deadline/backoff overrides for THIS
+    # process's [fabric] region land directly on the effective [proxy]
+    # settings, so every downstream consumer (single-group boot, the
+    # constellation, the Meridian roles) sees the derived budgets without
+    # per-call-site plumbing. DEPLOY.md "Geo-distribution (Atlas)"
+    # documents the rtt-ms derivation.
+    if cfg.fabric.region:
+        for k, v in cfg.retry.overrides_for(cfg.fabric.region).items():
+            setattr(cfg.proxy, k, v)
+
     # Telescope wiring: hand the process-wide flight recorder its incident
     # directory (it stays disabled without one — fault-path disk writes
     # are opt-in)
@@ -179,6 +189,15 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
 
         net = ChaosNet(net, seed=cfg.attacks.chaos_seed)
         stoppables.append(net)
+        if cfg.chaos.profiles:
+            # Atlas [chaos.profiles]: named WAN link matrix between
+            # region pairs. Endpoint -> region assignments arrive later
+            # (the constellation builder registers placements), which is
+            # fine — links key on region names and resolve per send.
+            from dds_tpu.geo import wan as _wan
+
+            _wan.apply_profiles(net, cfg.chaos.profiles,
+                                scale=cfg.chaos.scale)
 
     if cfg.shard.enabled:
         if cfg.transport.kind == "tcp":
@@ -388,6 +407,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
         ProxyConfig(
             host=cfg.proxy.host,
             port=cfg.proxy.port,
+            region=cfg.fabric.region,
             request_budget=cfg.proxy.request_budget,
             retry_backoff=cfg.proxy.retry_backoff,
             retry_max_delay=cfg.proxy.retry_max_delay,
@@ -448,10 +468,11 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
     from dds_tpu.obs.flight import flight as _flight
     from dds_tpu.obs.panopticon import process_info
 
-    _flight.configure(
-        identity={"host": local_hostport or "local", "role": "single"}
-    )
-    process_info(role="single")
+    _identity = {"host": local_hostport or "local", "role": "single"}
+    if cfg.fabric.region:
+        _identity["region"] = cfg.fabric.region
+    _flight.configure(identity=_identity)
+    process_info(role="single", region=cfg.fabric.region)
 
     if cfg.recovery.snapshot_dir and cfg.recovery.snapshot_interval > 0:
         from dds_tpu.core import snapshot as snap
@@ -537,6 +558,11 @@ def shard_configs(cfg: DDSConfig):
         breaker_threshold=cfg.proxy.breaker_threshold,
         breaker_reset=cfg.proxy.breaker_reset,
         fast_fail_all_open=cfg.admission.fast_fail,
+        # Atlas read-local lease client knobs ([geo]); region + per-group
+        # lease_ttl/replica_regions are stamped by the constellation
+        # builder, which is also what flips lease_enabled on
+        lease_renew_margin=cfg.geo.lease_renew_margin,
+        local_read_timeout=cfg.geo.local_read_timeout,
     )
     return rcfg, sup_cfg, abd_cfg
 
@@ -548,6 +574,7 @@ def proxy_config(cfg: DDSConfig, supervisor, ssl_server, ssl_client,
     kw = dict(
         host=cfg.proxy.host,
         port=cfg.proxy.port,
+        region=cfg.fabric.region,
         request_budget=cfg.proxy.request_budget,
         retry_backoff=cfg.proxy.retry_backoff,
         retry_max_delay=cfg.proxy.retry_max_delay,
@@ -638,6 +665,13 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
         sup_cfg=sup_cfg,
         abd_cfg=abd_cfg,
         chaos=cfg.attacks.chaos_enabled,
+        # Atlas: region-aware placement + read-local leases ([geo]); the
+        # builder signs the region assignment onto the shard map and
+        # homes this process's proxies at [fabric] region
+        regions=list(cfg.geo.regions) if cfg.geo.enabled else None,
+        placement=cfg.geo.placement,
+        lease_ttl=cfg.geo.lease_ttl if cfg.geo.enabled else 0.0,
+        client_region=cfg.fabric.region,
     )
     if sh.plan_dir:
         # a previous process may have died mid-reshard: resolve the
@@ -652,12 +686,22 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
         for g in const.groups:
             g.supervisor.start()
     if cfg.recovery.anti_entropy_enabled:
-        for node in replicas.values():
-            node.antientropy.configure(
-                interval=cfg.recovery.anti_entropy_interval,
-                jitter=cfg.recovery.anti_entropy_jitter,
-            )
-            node.antientropy.start()
+        for g in const.groups:
+            for node in g.replicas.values():
+                node.antientropy.configure(
+                    interval=cfg.recovery.anti_entropy_interval,
+                    jitter=cfg.recovery.anti_entropy_jitter,
+                )
+                if cfg.geo.enabled and g.replica_regions:
+                    # Atlas: cross-region pull pairing — a biased share
+                    # of rounds reaches across the WAN, extra-jittered so
+                    # regional fleets don't thunder over the slow links
+                    node.antientropy.configure(
+                        regions=g.replica_regions,
+                        cross_region_bias=cfg.geo.cross_region_bias,
+                        cross_jitter=cfg.geo.cross_jitter,
+                    )
+                node.antientropy.start()
 
     server = DDSRestServer(
         const.router,
@@ -684,6 +728,11 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
             promote=(lambda gid, c=const: c.promote(gid)),
             moved_bytes=lambda r=const.rebalancer: r.moved_bytes_total,
             reshard_busy=lambda r=const.rebalancer: r.lock.locked(),
+            # Atlas: gid -> home region, read live so split-born groups
+            # (which inherit the victim's region) appear without rewiring
+            regions=(lambda c=const: {
+                g.gid: g.home_region for g in c.groups if g.home_region
+            }) if cfg.geo.enabled else None,
         )
         if admission is not None:
             admission.subscribe(hm.on_admission)
@@ -697,8 +746,11 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
     from dds_tpu.obs.flight import flight as _flight
     from dds_tpu.obs.panopticon import process_info
 
-    _flight.configure(identity={"host": "local", "role": "constellation"})
-    process_info(role="constellation")
+    _identity = {"host": "local", "role": "constellation"}
+    if cfg.fabric.region:
+        _identity["region"] = cfg.fabric.region
+    _flight.configure(identity=_identity)
+    process_info(role="constellation", region=cfg.fabric.region)
     if cfg.obs.audit_enabled:
         from dds_tpu.obs.watchtower import watchtower
         from dds_tpu.utils.trace import tracer as _tracer
@@ -710,6 +762,12 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
             group_geometry={
                 g.gid: (g.quorum_size, len(g.active)) for g in const.groups
             },
+            # Atlas: lease-tagged single-hop reads are audited against
+            # the live lease tables instead of the quorum-size bound
+            lease_lookup=(lambda name, c=const: any(
+                g.lease_table is not None and g.lease_table.held_by(name)
+                for g in c.groups
+            )) if cfg.geo.enabled and cfg.geo.lease_ttl > 0 else None,
         )
         watchtower.attach(_tracer)
     return dep

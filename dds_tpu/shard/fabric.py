@@ -22,6 +22,8 @@ from dataclasses import dataclass, field
 from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
 from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
 from dds_tpu.core.supervisor import BFTSupervisor, SupervisorConfig
+from dds_tpu.geo.lease import LeaseTable
+from dds_tpu.geo.placement import group_regions, spread
 from dds_tpu.shard.rebalance import Rebalancer
 from dds_tpu.shard.router import ShardRouter
 from dds_tpu.shard.shardmap import ShardManager, ShardMap, ShardState
@@ -40,9 +42,21 @@ class ShardGroup:
     state: ShardState
     quorum_size: int
     trudy: object = None
+    # Atlas: replica endpoint -> region, the group's home region label,
+    # and the shared per-group read-lease table (None = leases off)
+    replica_regions: dict = field(default_factory=dict)
+    home_region: str = ""
+    lease_table: object = None
 
     def all_replicas(self) -> list[str]:
         return self.active + self.sentinent
+
+    def region_census(self) -> dict:
+        """region -> replica count, for /health and placement checks."""
+        out: dict = {}
+        for region in self.replica_regions.values():
+            out[region] = out.get(region, 0) + 1
+        return dict(sorted(out.items()))
 
     def export_from(self, endpoint: str) -> dict:
         """Export one replica's repository (migration seed DATA — every
@@ -71,6 +85,12 @@ class Constellation:
     # warm standbys: groups a merge retired (still running, pruned empty)
     # — the next split or takeover reuses one instead of building fresh
     standbys: list = field(default_factory=list)
+    # Atlas build parameters, kept so standby groups built later place
+    # the same way the original fleet did
+    geo_regions: list = field(default_factory=list)
+    geo_placement: object = "span"
+    geo_lease_ttl: float = 0.0
+    geo_client_region: str = ""
 
     @property
     def gids(self) -> list[str]:
@@ -91,12 +111,29 @@ class Constellation:
             n += 1
         return f"s{n}"
 
-    def _acquire_standby(self, gid: str | None = None) -> ShardGroup:
+    def regions_of_endpoints(self) -> dict:
+        """Every fabric endpoint's region label (replicas per their
+        placement; supervisor/proxy per the group home / client region) —
+        what ChaosNet region matrices and /health key off."""
+        out: dict = {}
+        for g in self.groups + self.standbys:
+            out.update(g.replica_regions)
+            if g.home_region:
+                out[g.supervisor.addr] = g.home_region
+            region = self.geo_client_region or g.home_region
+            if region:
+                out[g.client.addr] = region
+        return out
+
+    def _acquire_standby(self, gid: str | None = None,
+                         prefer_region: str = "") -> ShardGroup:
         """A serving-capable group outside the active map: a warm standby
         a merge retired, else a freshly built one (fenced until a map
         gives it keys, so it can be brought up eagerly without traffic).
         A caller naming `gid` (an operator's replayable split target)
-        gets that standby, or a fresh group under that name."""
+        gets that standby, or a fresh group under that name.
+        `prefer_region` picks a standby homed there when one exists (the
+        Atlas takeover preference); a fresh group is homed there too."""
         if gid is not None:
             for i, g in enumerate(self.standbys):
                 if g.gid == gid:
@@ -105,10 +142,32 @@ class Constellation:
                 raise ValueError(f"target group {gid!r} is already active")
         else:
             if self.standbys:
+                if prefer_region:
+                    for i, g in enumerate(self.standbys):
+                        if g.home_region == prefer_region:
+                            return self.standbys.pop(i)
                 return self.standbys.pop(0)
             gid = self._fresh_gid()
         state = ShardState(gid, self.manager.current(), self.secret)
-        return build_group(self.net, gid, state, **self._build_kwargs)
+        kwargs = dict(self._build_kwargs)
+        if self.geo_regions:
+            mode = (self.geo_placement.get(gid, "span")
+                    if isinstance(self.geo_placement, dict)
+                    else self.geo_placement)
+            home = prefer_region or self.geo_regions[0]
+            kwargs["regions"] = ([home] if mode == "home"
+                                 else list(self.geo_regions))
+            kwargs["home_region"] = home
+            kwargs["lease_ttl"] = self.geo_lease_ttl
+        group = build_group(self.net, gid, state, **kwargs)
+        if self.geo_regions and hasattr(self.net, "set_regions"):
+            labels = dict(group.replica_regions)
+            if group.home_region:
+                labels[group.supervisor.addr] = group.home_region
+                labels[group.client.addr] = (self.geo_client_region
+                                             or group.home_region)
+            self.net.set_regions(labels)
+        return group
 
     def _adopt(self, group: ShardGroup) -> None:
         self.groups.append(group)
@@ -162,7 +221,9 @@ class Constellation:
         from dds_tpu.shard.rebalance import _maybe_await
 
         dead = self.group(dead_gid)
-        standby = self._acquire_standby()
+        # prefer a standby homed where the dead group lived — the
+        # relabeled slice keeps its geography (and its WAN profile)
+        standby = self._acquire_standby(prefer_region=dead.home_region)
         new_map = (self.manager.current()
                    .relabel(dead_gid, standby.gid).sign(self.secret))
         self.groups.remove(dead)
@@ -198,12 +259,22 @@ def build_group(
     chaos: bool = False,
     rng: random.Random | None = None,
     namer=None,
+    regions: list[str] | None = None,
+    home_region: str = "",
+    lease_ttl: float = 0.0,
 ) -> ShardGroup:
     """One namespaced quorum group over `net`, fencing under `state`.
 
     `namer` maps a bare endpoint name to its transport address — identity
     for the in-memory fabric, `TcpNet.local_addr` for a Meridian group
-    process so every endpoint is a routable `host:port/name`."""
+    process so every endpoint is a routable `host:port/name`.
+
+    Atlas: `regions` spreads the group's replicas round-robin across the
+    listed regions (the span-group shape read-local leases need);
+    `home_region` labels the group (and places the supervisor — defaults
+    to the first region). `lease_ttl > 0` installs the group's shared
+    read-lease table on every replica, switching its coordinators to the
+    holder-pinned quorum geometry (dds_tpu/geo)."""
     import dataclasses as _dc
 
     namer = namer or (lambda name: name)
@@ -213,10 +284,20 @@ def build_group(
     ]
     active, sentinent = endpoints[:n_active], endpoints[n_active:]
     sup_addr = namer(f"{gid}-supervisor")
+    replica_regions = spread(endpoints, regions or [])
+    if regions and not home_region:
+        home_region = regions[0]
     replicas = {
         e: BFTABDNode(e, endpoints, sup_addr, net, rcfg, shard=state)
         for e in endpoints
     }
+    lease_table = None
+    if lease_ttl > 0 and regions:
+        # one table per group, shared by its replicas — the same
+        # in-process config-push idiom as ShardState
+        lease_table = LeaseTable(gid, state.secret)
+        for node in replicas.values():
+            node.lease_table = lease_table
     for e in sentinent:
         replicas[e].behavior = "sentinent"
     supervisor = BFTSupervisor(
@@ -231,6 +312,13 @@ def build_group(
         abd_cfg = _dc.replace(abd_cfg)
     abd_cfg.shard = gid
     abd_cfg.supervisor = sup_addr
+    if replica_regions:
+        abd_cfg.replica_regions = dict(replica_regions)
+        if lease_ttl > 0:
+            abd_cfg.lease_ttl = lease_ttl
+            if abd_cfg.region:
+                # a client without a home region stays on the quorum path
+                abd_cfg.lease_enabled = True
     client = AbdClient(namer(f"{gid}-proxy"), net, active, abd_cfg)
     if chaos:
         from dds_tpu.malicious.trudy import Nemesis
@@ -243,7 +331,9 @@ def build_group(
         trudy = Trudy(net, active, max_faults, addr=namer(f"{gid}-trudy"),
                       rng=rng)
     return ShardGroup(gid, active, sentinent, replicas, supervisor, client,
-                      state, quorum, trudy)
+                      state, quorum, trudy,
+                      replica_regions=replica_regions,
+                      home_region=home_region, lease_table=lease_table)
 
 
 def build_constellation(
@@ -260,19 +350,39 @@ def build_constellation(
     journal_dir: str | None = None,
     seed: int | None = None,
     namer=None,
+    regions: list[str] | None = None,
+    placement="span",
+    lease_ttl: float = 0.0,
+    client_region: str = "",
     **group_kwargs,
 ) -> Constellation:
-    """S homogeneous groups + manager/router/rebalancer over one fabric."""
+    """S homogeneous groups + manager/router/rebalancer over one fabric.
+
+    Atlas: `regions` switches the constellation geo-aware — group homes
+    are assigned round-robin and carried (signed) on the ShardMap, and
+    each group's replicas are placed per `placement`: `"span"` spreads
+    every group across all regions (the read-local lease shape), `"home"`
+    packs each group into its home region (the shape whose heartbeats die
+    with the region), or a dict gid -> mode mixes both. `lease_ttl > 0`
+    installs per-group read-lease tables; `client_region` homes every
+    group's proxy client (enabling its lease fast path) in one region.
+    When `net` is a ChaosNet, every endpoint is registered with its
+    region so `[chaos.profiles]` WAN matrices apply unchanged."""
     gids = [f"s{i}" for i in range(shard_count)]
-    smap = ShardMap.build(gids, vnodes_per_group).sign(secret)
+    homes = group_regions(gids, regions or [])
+    smap = ShardMap.build(gids, vnodes_per_group,
+                          regions=homes or None).sign(secret)
     manager = ShardManager(smap, secret)
     rng = random.Random(seed) if seed is not None else None
     groups = []
     for gid in gids:
         state = ShardState(gid, smap, secret)
         grp_rng = random.Random(rng.getrandbits(64)) if rng else None
-        groups.append(build_group(net, gid, state, rng=grp_rng, namer=namer,
-                                  **group_kwargs))
+        groups.append(build_group(
+            net, gid, state, rng=grp_rng, namer=namer,
+            **_geo_group_kwargs(group_kwargs, gid, regions, homes,
+                                placement, lease_ttl, client_region),
+        ))
     router = ShardRouter(manager, {g.gid: g.client for g in groups})
     rebalancer = Rebalancer(
         manager, net, secret,
@@ -281,6 +391,48 @@ def build_constellation(
         ack_timeout=ack_timeout, chunk_keys=chunk_keys, prune=prune,
         fence_lease=fence_lease, journal_dir=journal_dir,
     )
-    return Constellation(manager, router, groups, rebalancer, net=net,
-                         secret=secret,
-                         _build_kwargs=dict(group_kwargs, namer=namer))
+    constellation = Constellation(
+        manager, router, groups, rebalancer, net=net, secret=secret,
+        _build_kwargs=dict(group_kwargs, namer=namer),
+        geo_regions=list(regions or []), geo_placement=placement,
+        geo_lease_ttl=lease_ttl, geo_client_region=client_region,
+    )
+    if regions:
+        _register_net_regions(net, constellation)
+    return constellation
+
+
+def _geo_group_kwargs(group_kwargs: dict, gid: str, regions, homes: dict,
+                      placement, lease_ttl: float,
+                      client_region: str) -> dict:
+    """Per-group build kwargs with the Atlas placement resolved."""
+    kwargs = dict(group_kwargs)
+    if not regions:
+        return kwargs
+    mode = placement.get(gid, "span") if isinstance(placement, dict) \
+        else placement
+    home = homes.get(gid, regions[0])
+    kwargs["regions"] = [home] if mode == "home" else list(regions)
+    kwargs["home_region"] = home
+    kwargs["lease_ttl"] = lease_ttl
+    if client_region and lease_ttl > 0:
+        import dataclasses as _dc
+
+        abd_cfg = kwargs.get("abd_cfg")
+        abd_cfg = _dc.replace(abd_cfg) if abd_cfg is not None \
+            else AbdClientConfig(quorum_size=kwargs.get("quorum", 3))
+        abd_cfg.region = client_region
+        kwargs["abd_cfg"] = abd_cfg
+    return kwargs
+
+
+def _register_net_regions(net, constellation: Constellation) -> None:
+    """Label every fabric endpoint with its region on a ChaosNet, so
+    `[chaos.profiles]` region-pair matrices and `region_partition` apply
+    to the constellation without per-test bookkeeping."""
+    if not hasattr(net, "set_regions"):
+        return
+    net.set_regions(constellation.regions_of_endpoints())
+
+
+

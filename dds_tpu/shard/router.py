@@ -308,6 +308,13 @@ class ShardRouter:
                 "degraded": len(reachable) < c.cfg.quorum_size,
                 "vnodes": sum(1 for _, g in smap.vnodes if g == gid),
             }
+            # Atlas: home-region label (from the signed map) + this
+            # client's live lease session, when the group is geo-aware
+            region = smap.region_of(gid)
+            if region:
+                out[gid]["region"] = region
+            if c.cfg.lease_enabled:
+                out[gid]["lease"] = c.lease_state()
         return out
 
     def status(self) -> dict:

@@ -484,6 +484,11 @@ class FabricConfig:
 
     role: str = "all"
     groups: dict = field(default_factory=dict)    # gid -> "host:port"
+    # Atlas (dds_tpu/geo): the region THIS process runs in. Surfaces as
+    # the `region` label on /health, /metrics, and Panopticon federation,
+    # homes this process's proxy for read-local leases, and keys the
+    # [retry] per-region overrides. Empty = geo-unaware.
+    region: str = ""
     # REST "host:port" peers serving GET /shards (group status listeners
     # and/or other proxies) — bootstrap + gossip sources
     bootstrap: list[str] = field(default_factory=list)
@@ -544,6 +549,96 @@ class HelmsmanConfig:
 
 
 @dataclass
+class GeoConfig:
+    """Atlas geo-distribution plane (dds_tpu/geo): region-aware replica
+    placement, TTL-leased read-local quorum geometry, and cross-region
+    anti-entropy pairing. With `enabled = true` the constellation builder
+    spreads each group's replicas across `regions` (placement = "span")
+    or packs groups into round-robin home regions ("home"), carries the
+    signed region assignment on the ShardMap, and — when `lease_ttl > 0`
+    — installs per-group read-lease tables so an in-region replica can
+    answer reads in one hop while every quorum its group closes includes
+    the lease holders (the safety argument in dds_tpu/geo).
+    DEPLOY.md "Geo-distribution (Atlas)" is the runbook."""
+
+    enabled: bool = False
+    regions: list[str] = field(default_factory=list)
+    placement: str = "span"            # span | home
+    # read-local leases: TTL per grant, renew when remaining < margin,
+    # and the single-hop LocalRead budget before quorum fallback.
+    # lease_ttl = 0 disables leases (placement/labels still apply).
+    lease_ttl: float = 2.0
+    lease_renew_margin: float = 0.5
+    local_read_timeout: float = 0.75
+    # anti-entropy cross-region pairing: probability a pull round goes
+    # cross-region, plus extra de-synchronising sleep before WAN rounds
+    cross_region_bias: float = 0.5
+    cross_jitter: float = 0.5
+
+
+@dataclass
+class RetryConfig:
+    """Per-region retry/deadline overrides (`[retry]`, Atlas): a proxy in
+    a 100-300 ms-RTT region needs different budgets than a same-rack one.
+    `profiles` maps a region name to an override table applied over the
+    [proxy] defaults for processes whose `[fabric] region` matches:
+
+        [retry.profiles.eu]
+        rtt-ms = 120                 # derivation input, see below
+        request-budget = 4.0         # explicit keys win over derivation
+
+    With `rtt-ms` set, unset keys derive from one WAN round trip R (the
+    floor any cross-region attempt must clear; DEPLOY.md "Geo-
+    distribution (Atlas)" documents the rationale): retry-backoff = 2R
+    (first backoff outlives one in-flight straggler), retry-max-delay =
+    8R, request-budget = 24R (~3 attempts at max backoff), and
+    retry-after-hint = 2R."""
+
+    profiles: dict = field(default_factory=dict)
+
+    _KEYS = ("request_budget", "retry_backoff", "retry_max_delay",
+             "retry_after_hint", "intranet_request_timeout")
+
+    def overrides_for(self, region: str) -> dict:
+        """Effective [proxy]-field overrides for `region` (snake_case
+        keys); {} when the region has no profile."""
+        prof = {k.replace("-", "_"): v
+                for k, v in dict(self.profiles.get(region, {})).items()}
+        out: dict = {}
+        rtt_ms = prof.pop("rtt_ms", None)
+        if rtt_ms is not None:
+            rtt = float(rtt_ms) / 1e3
+            out["retry_backoff"] = 2.0 * rtt
+            out["retry_max_delay"] = 8.0 * rtt
+            out["request_budget"] = 24.0 * rtt
+            out["retry_after_hint"] = 2.0 * rtt
+        unknown = set(prof) - set(self._KEYS)
+        if unknown:
+            raise ValueError(
+                f"unknown [retry.profiles.{region}] keys {sorted(unknown)}"
+            )
+        for k, v in prof.items():
+            out[k] = float(v)
+        return out
+
+
+@dataclass
+class ChaosNetConfig:
+    """Seeded WAN fault fabric (`[chaos]`, Atlas): named link profiles
+    applied to the ChaosNet that `attacks.chaos_enabled` wraps the
+    transport in. `profiles` maps a directed ("eu->us") or symmetric
+    ("eu<->us") region pair to a WAN preset name ("wan-100" | "wan-200" |
+    "wan-300", RTT milliseconds) or an explicit spec table (delay-ms /
+    jitter-ms / drop / duplicate / reorder / corrupt) — parsed by
+    dds_tpu/geo/wan.py, the ONE loader tests and benchmarks share so both
+    see the identical seeded WAN. `scale` shrinks every delay uniformly
+    (tests run the same topology at a fraction of real time)."""
+
+    profiles: dict = field(default_factory=dict)
+    scale: float = 1.0
+
+
+@dataclass
 class AttackConfig:
     enabled: bool = False
     # crash | byzantine | partition | delay | flood | heal (the network
@@ -577,6 +672,9 @@ class DDSConfig:
     fabric: FabricConfig = field(default_factory=FabricConfig)
     helmsman: HelmsmanConfig = field(default_factory=HelmsmanConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
+    geo: GeoConfig = field(default_factory=GeoConfig)
+    retry: RetryConfig = field(default_factory=RetryConfig)
+    chaos: ChaosNetConfig = field(default_factory=ChaosNetConfig)
     debug: bool = False
 
     # ------------------------------------------------------------- loading
@@ -632,6 +730,9 @@ _SUBSECTIONS = {
     ("DDSConfig", "fabric"): FabricConfig,
     ("DDSConfig", "helmsman"): HelmsmanConfig,
     ("DDSConfig", "crypto"): CryptoConfig,
+    ("DDSConfig", "geo"): GeoConfig,
+    ("DDSConfig", "retry"): RetryConfig,
+    ("DDSConfig", "chaos"): ChaosNetConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
     ("ObsConfig", "fleet"): FleetObsConfig,
 }

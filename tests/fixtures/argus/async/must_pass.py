@@ -57,3 +57,20 @@ async def steer_loop():
 def start_steering():
     task = supervised_task(steer_loop(), name="fixture.steer")
     return task
+
+
+async def lease_keeper_loop(client):
+    """Atlas-style read-local lease session keeper, sanctioned shape:
+    the renewal loop is spawned supervised, the session state it mutates
+    sits behind an ``asyncio.Lock``, and a lost lease is reported
+    through the async flight recorder instead of a blocking call."""
+    while not _STOP.is_set():
+        async with _LOCK:
+            lease = await client.ensure_lease()
+        if lease is None:
+            await flight.record_async("geo", action="lease_lost")
+        await asyncio.sleep(0.1)
+
+
+def start_lease_keeper(client):
+    return supervised_task(lease_keeper_loop(client), name="fixture.lease")
