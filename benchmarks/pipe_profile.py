@@ -1,0 +1,195 @@
+"""Chronoscope pipe profile on a real multi-process Meridian fleet.
+
+    python -m benchmarks.pipe_profile [--rate 60] [--duration 2]
+
+Spawns the benchmarks/multihost_load loopback fleet (2 group processes +
+1 proxy, Panopticon shipping armed) and drives it with the coordinated-
+omission-safe open-loop generator, then scrapes two surfaces the run
+exists to validate against each other:
+
+- `GET /profile` — the proxy's local Chronoscope aggregate: per-route
+  per-stage critical-path self-times and the attribution coverage
+  (fraction of request wall time landing in NAMED stages).
+- `GET /fleet/profile` — the Panopticon rollup of every host's
+  `dds_pipe_*` gauges, naming the fleet-wide bottleneck stage.
+
+The record carries both top stages plus `agree` (they must name the same
+bottleneck for the profile to be trustworthy) and `overhead_pct`: the
+goodput cost of profiling, measured by re-running the identical fleet
+with DDS_OBS_PIPE=0 in every process. Chronoscope is supposed to be
+free-ish (subscriber-side analysis off the request path), so CI watches
+that number stays small.
+
+One `pipe profile` record lands via `benchmarks.common.emit`;
+`sentry.py --check` validates its shape (exit 2 on malformed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.multihost_load import Fleet  # noqa: E402
+
+
+def _stanzas(collector: str) -> tuple[str, str]:
+    """(group_extra, proxy_extra) TOML arming the Panopticon plane — the
+    fleet rollup needs the groups' gauges shipped to the collector."""
+    group = f"""
+[obs.fleet]
+enabled = true
+collector = "{collector}"
+flush-interval = 0.1
+"""
+    proxy = """
+[obs.fleet]
+enabled = true
+stitch-window = 0.5
+"""
+    return group, proxy
+
+
+async def _measure(fleet: Fleet, rate: float, duration: float, keys: int,
+                   zipf_s: float, seed: int):
+    from dds_tpu.fabric.loadgen import OpenLoopLoad
+
+    load = OpenLoopLoad(fleet.proxy_targets, keys=keys, zipf_s=zipf_s,
+                        seed=seed, timeout=5.0)
+    await load.seed()
+    return await load.run(rate, duration)
+
+
+async def _get_json(port: int, path: str) -> dict:
+    from dds_tpu.http.miniserver import http_request
+
+    status, body = await http_request("127.0.0.1", port, "GET", path,
+                                      timeout=5.0)
+    if status != 200:
+        raise RuntimeError(f"GET {path} -> {status}")
+    text = body.decode() if isinstance(body, (bytes, bytearray)) else str(body)
+    return json.loads(text)
+
+
+def _pick_route(routes: dict) -> str | None:
+    """The PutSet route when profiled, else the busiest route."""
+    for route in routes:
+        if "PutSet" in route:
+            return route
+    best = None
+    for route, rs in routes.items():
+        if best is None or rs.get("count", 0) > routes[best].get("count", 0):
+            best = route
+    return best
+
+
+def _run_one(profiler_on: bool, rate: float, duration: float, keys: int,
+             zipf_s: float, seed: int):
+    """One fleet run; returns (load report, /profile body, /fleet/profile
+    body, process count). The off run disables Chronoscope in every
+    process via DDS_OBS_PIPE=0 (inherited by the spawned fleet), keeping
+    everything else — shipping included — identical."""
+    prev = os.environ.get("DDS_OBS_PIPE")
+    if not profiler_on:
+        os.environ["DDS_OBS_PIPE"] = "0"
+    profile = fleet_profile = {}
+    try:
+        with tempfile.TemporaryDirectory(prefix="pipe-profile-") as workdir:
+            fleet = Fleet(workdir)
+            fleet.group_extra, fleet.proxy_extra = _stanzas(
+                fleet.proxy_transport)
+            try:
+                fleet.start()
+                asyncio.run(fleet.wait_healthy())
+                report = asyncio.run(
+                    _measure(fleet, rate, duration, keys, zipf_s, seed))
+                if profiler_on:
+                    # settle one stitch window + ship interval so stitched
+                    # trees are profiled and group gauges reach the rollup
+                    asyncio.run(asyncio.sleep(1.5))
+                    port = fleet.ports["proxy"][0]
+                    profile = asyncio.run(_get_json(port, "/profile"))
+                    fleet_profile = asyncio.run(
+                        _get_json(port, "/fleet/profile"))
+            finally:
+                fleet.stop()
+            procs = len(fleet.gids) + len(fleet.ports["proxy"])
+    finally:
+        if prev is None:
+            os.environ.pop("DDS_OBS_PIPE", None)
+        else:
+            os.environ["DDS_OBS_PIPE"] = prev
+    return report, profile, fleet_profile, procs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rate", type=float, default=60.0,
+                    help="open-loop arrival rate (req/s)")
+    ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--keys", type=int, default=32)
+    ap.add_argument("--zipf", type=float, default=1.1)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--skip-overhead", action="store_true",
+                    help="skip the profiler-off comparison run")
+    args = ap.parse_args(argv)
+
+    from benchmarks.common import emit
+
+    on, profile, fleet_profile, procs = _run_one(
+        True, args.rate, args.duration, args.keys, args.zipf, args.seed)
+
+    routes = profile.get("routes") or {}
+    route = _pick_route(routes)
+    rs = routes.get(route) or {}
+    stages = {
+        k: v.get("p95_ms", 0.0) for k, v in (rs.get("stages") or {}).items()
+    }
+    top_stage = rs.get("top_stage") or "other"
+    f_routes = (fleet_profile.get("fleet") or {}).get("routes") or {}
+    f_top = (f_routes.get(route) or {}).get("top_stage") or {}
+    fleet_top_stage = f_top.get("stage") or ""
+    # both surfaces must finger the same bottleneck for the route; the
+    # rollup takes max-across-hosts, so on a local-stage bottleneck the
+    # fleet answer is exactly the proxy's own gauge
+    agree = bool(fleet_top_stage) and fleet_top_stage == top_stage
+
+    overhead = 0.0
+    off_good = None
+    if not args.skip_overhead:
+        off, _, _, _ = _run_one(
+            False, args.rate, args.duration, args.keys, args.zipf, args.seed)
+        off_good = off.good
+        overhead = 1.0 - (on.good / max(1, off.good))
+
+    return [emit(
+        "pipe profile",
+        rs.get("wall_p95_ms", 0.0),
+        "ms",
+        rs.get("coverage", 0.0),
+        rate=args.rate,
+        duration=args.duration,
+        processes=procs,
+        open_loop=True,
+        route=route or "",
+        wall_p95_ms=rs.get("wall_p95_ms", 0.0),
+        coverage=rs.get("coverage", 0.0),
+        top_stage=top_stage,
+        stages=stages,
+        fleet_top_stage=fleet_top_stage,
+        agree=agree,
+        traces_profiled=profile.get("traces_profiled", 0),
+        on_good=on.good,
+        off_good=off_good,
+        overhead_pct=round(overhead * 100.0, 2),
+    )]
+
+
+if __name__ == "__main__":
+    main()

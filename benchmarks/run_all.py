@@ -21,9 +21,10 @@ def main(argv=None):
     from benchmarks import (analytics_matvec, audit_cost, autoscale_goodput,
                             bft_sum, crossover, decrypt_throughput,
                             encrypt_modexp, fleet_obs_overhead, geo_latency,
-                            mixed, multihost_load, overload_goodput, product,
-                            put_concurrency, resident_fold, search_latency,
-                            shard_scaling, sweep)
+                            mixed, multihost_load, overload_goodput,
+                            pipe_profile, product, put_concurrency,
+                            resident_fold, search_latency, shard_scaling,
+                            sweep)
 
     rows = []
     if args.quick:
@@ -45,6 +46,9 @@ def main(argv=None):
             ["--rates", "40,100", "--duration", "1.5", "--keys", "24"]
         )
         rows += fleet_obs_overhead.main(
+            ["--rate", "40", "--duration", "1.5", "--keys", "24"]
+        )
+        rows += pipe_profile.main(
             ["--rate", "40", "--duration", "1.5", "--keys", "24"]
         )
         rows += resident_fold.main(
@@ -73,6 +77,7 @@ def main(argv=None):
         rows += overload_goodput.main([])
         rows += multihost_load.main([])
         rows += fleet_obs_overhead.main([])
+        rows += pipe_profile.main([])
         rows += resident_fold.main([])
         rows += decrypt_throughput.main([])
         rows += search_latency.main([])

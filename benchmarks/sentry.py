@@ -12,12 +12,14 @@ Modes (all emit one JSON line to stdout):
         `multihost load` (benchmarks/multihost_load.py),
         `resident fold` (benchmarks/resident_fold.py),
         `fleet obs` (benchmarks/fleet_obs_overhead.py),
+        `pipe profile` (benchmarks/pipe_profile.py),
         `decrypt throughput` (benchmarks/decrypt_throughput.py),
         `search latency` (benchmarks/search_latency.py) and
         `autoscale goodput` (benchmarks/autoscale_goodput.py) records
         in benchmarks/results.json / results_quick.json so a malformed
-        scaling, analytics, overload, multihost, fleet-obs, resident,
-        decrypt, search or autoscale record is caught by the same smoke.
+        scaling, analytics, overload, multihost, fleet-obs, pipe,
+        resident, decrypt, search or autoscale record is caught by the
+        same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -440,6 +442,53 @@ def _check_geo_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_pipe_records(root: str = REPO) -> dict:
+    """Validate `pipe profile` rows (benchmarks/pipe_profile.py): positive
+    p95 wall-time value, a detail block naming the profiled route, a
+    coverage fraction in [0, 1], a top stage drawn from the Chronoscope
+    taxonomy, a non-empty stages dict of non-negative per-stage p95s, the
+    fleet rollup's top stage alongside the agreement flag (the
+    local-vs-fleet cross-check the record exists for), an OS-process
+    count >= 2, the open-loop flag, and a numeric profiling-overhead
+    percentage (any sign — noise can make the profiled run faster). Same
+    malformed contract as the other row families: exit 2."""
+    from dds_tpu.obs.chronoscope import STAGES
+
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("pipe profile")):
+            continue
+        detail = row.get("detail")
+        stages = detail.get("stages") if isinstance(detail, dict) else None
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("route"), str) and detail["route"]
+            and isinstance(detail.get("wall_p95_ms"), (int, float))
+            and detail["wall_p95_ms"] > 0
+            and isinstance(detail.get("coverage"), (int, float))
+            and 0.0 <= detail["coverage"] <= 1.0
+            and detail.get("top_stage") in STAGES
+            and isinstance(stages, dict) and stages
+            and all(isinstance(v, (int, float)) and v >= 0
+                    for v in stages.values())
+            and isinstance(detail.get("fleet_top_stage"), str)
+            and isinstance(detail.get("agree"), bool)
+            and isinstance(detail.get("processes"), int)
+            and detail["processes"] >= 2
+            and detail.get("open_loop") is True
+            and isinstance(detail.get("overhead_pct"), (int, float))
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed pipe-profile record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _load_fresh(path: str) -> dict:
     """A stats JSON: either the baseline schema or a bare kernels dict."""
     with open(path) as f:
@@ -484,6 +533,7 @@ def main(argv=None) -> int:
             overload = _check_overload_records()
             multihost = _check_multihost_records()
             fleet_obs = _check_fleet_obs_records()
+            pipe = _check_pipe_records()
             resident = _check_resident_records()
             decrypt = _check_decrypt_records()
             search = _check_search_records()
@@ -501,6 +551,7 @@ def main(argv=None) -> int:
             "overload_rows": overload["rows"],
             "multihost_rows": multihost["rows"],
             "fleet_obs_rows": fleet_obs["rows"],
+            "pipe_rows": pipe["rows"],
             "resident_rows": resident["rows"],
             "decrypt_rows": decrypt["rows"],
             "search_rows": search["rows"],

@@ -37,6 +37,7 @@ from dds_tpu.core.errors import (
     WrongShardError,
 )
 from dds_tpu.core.transport import Transport
+from dds_tpu.obs import context as obs_context
 from dds_tpu.obs.metrics import metrics
 from dds_tpu.utils.retry import CircuitBreaker, Deadline, DeadlineExceededError
 from dds_tpu.utils.trace import tracer
@@ -258,6 +259,17 @@ class AbdClient:
         raise WrongShardError(key, replica_epoch=reply.epoch,
                               sent_epoch=self._epoch())
 
+    @staticmethod
+    def _note_verify(op: str, t0: float) -> None:
+        """Record reply-HMAC verification as its own `abd.verify` span —
+        Chronoscope's hmac-verify stage, carved out of quorum-rtt so crypto
+        cost is never misread as network cost."""
+        cur = obs_context.current()
+        tracer.record(
+            "abd.verify", (time.perf_counter() - t0) * 1e3,
+            _ctx=obs_context.child(cur) if cur is not None else None, op=op,
+        )
+
     def _attempt_timeout(self, deadline: Optional[Deadline]) -> float:
         """Per-attempt timeout, clipped to the caller's remaining budget."""
         if deadline is None:
@@ -388,10 +400,13 @@ class AbdClient:
                     if rnonce != challenge:
                         self._coord_failed(coord)
                         raise ByzFailedNonceChallengeError(coord)
-                    if not sigs.validate_proxy_signature(
+                    t_v = time.perf_counter()
+                    verified = sigs.validate_proxy_signature(
                         cfg.proxy_mac_secret, k, rnonce, rsig,
                         [value, sigs.tag_payload(tag)],
-                    ):
+                    )
+                    self._note_verify("read", t_v)
+                    if not verified:
                         self._coord_failed(coord)
                         raise ByzInvalidSignatureError(coord)
                     if k != key:
@@ -432,10 +447,13 @@ class AbdClient:
                     if rnonce != challenge:
                         self._coord_failed(coord)
                         raise ByzFailedNonceChallengeError(coord)
-                    if not sigs.validate_proxy_signature(
+                    t_v = time.perf_counter()
+                    verified = sigs.validate_proxy_signature(
                         cfg.proxy_mac_secret, k, rnonce, rsig,
                         sigs.tag_payload(tag),
-                    ):
+                    )
+                    self._note_verify("write", t_v)
+                    if not verified:
                         self._coord_failed(coord)
                         raise ByzInvalidSignatureError(coord)
                     if k != key:

@@ -343,6 +343,7 @@ class TcpNet(Transport):
 
     async def _send(self, src: str, dest: str, msg: object) -> None:
         import json
+        import time
 
         host, port, _ = self.split(dest)
         conn_key = f"{host}:{port}"
@@ -352,6 +353,7 @@ class TcpNet(Transport):
                 if w is None or w.is_closing():
                     _, w = await asyncio.open_connection(host, port, ssl=self._ssl_client)
                     self._conns[conn_key] = w
+            t_ser = time.perf_counter()
             payload = M.to_dict(msg)
             obj = {"src": src, "dest": dest, "msg": payload}
             # trace-context propagation (ensure_future copied the caller's
@@ -369,6 +371,19 @@ class TcpNet(Transport):
                 if self._node_key is not None:
                     obj["sig"] = self._node_key.sign(body).hex()
             frame = json.dumps(obj).encode()
+            if tc is not None:
+                # Chronoscope's serialize stage: dict-encode + json + frame
+                # MAC/signature, attributed to the SENDER's span (tc is only
+                # non-None inside one)
+                from dds_tpu.utils.trace import tracer
+
+                cur = obs_context.current()
+                tracer.record(
+                    "net.serialize",
+                    (time.perf_counter() - t_ser) * 1e3,
+                    _ctx=obs_context.child(cur) if cur is not None else None,
+                    bytes=len(frame), dest=dest.rsplit("/", 1)[-1],
+                )
             if len(frame) > self.MAX_FRAME:
                 # symmetric with the receive bound: sending it anyway would
                 # get the shared cached connection killed at the receiver,
@@ -378,8 +393,15 @@ class TcpNet(Transport):
                     len(frame), src, dest, self.MAX_FRAME,
                 )
                 return
+            t_drain = time.perf_counter()
             w.write(len(frame).to_bytes(4, "big") + frame)
             await w.drain()
+            from dds_tpu.obs.metrics import metrics
+
+            metrics.observe(
+                "dds_net_drain_seconds", time.perf_counter() - t_drain,
+                help="TCP send-buffer drain wait (backpressure signal)",
+            )
         except OSError:
             log.warning("send failed %s -> %s", src, dest)
             self._conns.pop(conn_key, None)

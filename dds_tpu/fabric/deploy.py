@@ -527,6 +527,9 @@ async def _launch_all(cfg, net, stoppables, ssl_server, ssl_client):
         geometry={g.gid: (g.quorum_size, len(g.active))
                   for g in const.groups},
     )
+    from dds_tpu.obs.chronoscope import chronoscope
+
+    chronoscope.attach()
     return dep
 
 
@@ -632,6 +635,12 @@ async def _launch_group(cfg, net, stoppables, ssl_server, ssl_client,
         cfg, check_quorum=False,
         geometry={gid: (sh.quorum_size, sh.replicas_per_group)},
     )
+    # Chronoscope on the raw tracer: this process owns the replica-apply /
+    # ingest-queue / h2d stages, and its dds_pipe_* gauges ride the span
+    # shipper's metrics_text to the proxy's fleet rollup
+    from dds_tpu.obs.chronoscope import chronoscope
+
+    chronoscope.attach()
     return dep
 
 
@@ -788,6 +797,14 @@ async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
                 check_quorum=cfg.obs.audit_quorum_checks,
                 group_geometry=_audit_geometry(smap),
             )
+        # Chronoscope follows the same once-per-trace rule as the
+        # Watchtower: fed exclusively through the collector's stitched
+        # replay (detached from the raw tracer), so its critical paths
+        # include the remote replica-apply / ingest-queue / h2d spans
+        from dds_tpu.obs.chronoscope import chronoscope
+
+        chronoscope.detach()
+        collector.profiler = chronoscope
     else:
         # no replica handler spans in this process: tag/repair/state-
         # machine audits stay on, quorum-intersection ones can't be sound
@@ -795,4 +812,7 @@ async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
             cfg, check_quorum=False,
             geometry=_audit_geometry(smap),
         )
+        from dds_tpu.obs.chronoscope import chronoscope
+
+        chronoscope.attach()
     return dep

@@ -92,7 +92,8 @@ _RETRYABLE = (ByzantineError, WrongShardError, asyncio.TimeoutError,
 # reshard control route) bypass the Bulwark gate entirely and keep
 # answering through a full shed.
 _ADMISSION_EXEMPT = frozenset({"health", "metrics", "slo", "shards",
-                               "fleet", "_trace", "_reshard", "_helmsman"})
+                               "fleet", "profile", "_trace", "_reshard",
+                               "_helmsman"})
 
 
 @dataclass
@@ -191,6 +192,11 @@ class ProxyConfig:
     # is: it is the health surface operators page on, and it reveals no
     # more workload shape than the per-route metric series already do.
     slo_route_enabled: bool = True
+    # GET /profile (Chronoscope per-route/per-stage pipe profile +
+    # slow-trace exemplars, obs/chronoscope). Default ON like /slo — the
+    # per-stage aggregate reveals less workload shape than /_trace; the
+    # DDS_OBS_PIPE=0 env kill-switch disables profiling itself.
+    profile_route_enabled: bool = True
     # Prism encrypted-analytics routes (analytics/prism.py): POST /MatVec,
     # /WeightedSum, /GroupBySum evaluate plaintext-weight x ciphertext
     # products server-side over public parameters only. The row cap bounds
@@ -300,7 +306,8 @@ class DDSRestServer:
         self._tasks: list[asyncio.Task] = []
         self._keys_dirty = False
         self._keys_saver: asyncio.Task | None = None
-        # modulus -> [(operands, future)]; drained by _drain_folds
+        # modulus -> [(enqueue_t, operands, future, waiter trace ctx)];
+        # drained by _drain_folds
         self._fold_pending: dict[int, list] = {}
         self._fold_drainer: asyncio.Task | None = None
         self._folds_inflight = 0  # folds currently executing (any path)
@@ -442,7 +449,7 @@ class DDSRestServer:
             await _cancel_task(self._fold_drainer)
             err = ConnectionError("proxy stopping")
             for _, group in self._fold_pending.items():
-                for _, fut in group:
+                for _, _, fut, _ in group:
                     if not fut.done():
                         fut.set_exception(err)
             self._fold_pending.clear()
@@ -1270,10 +1277,13 @@ class DDSRestServer:
 
     async def handle(self, req: Request) -> Response:
         route = req.path.split("/", 2)[1] if "/" in req.path else req.path
+        adm_ms = None
         if self.admission is not None and route not in _ADMISSION_EXEMPT:
+            t_adm = time.perf_counter()
             decision = self.admission.decide(
                 route, req.headers.get(self.admission.tenant_header, "default")
             )
+            adm_ms = (time.perf_counter() - t_adm) * 1e3
             if not decision.admitted:
                 return self._admission_reject(decision, route, req.method)
         # Trace root minted at the edge (or stitched under an upstream
@@ -1290,6 +1300,11 @@ class DDSRestServer:
         status = 500
         try:
             with tracer.span(f"http.{req.method}.{route or 'root'}", _ctx=ctx):
+                if adm_ms is not None:
+                    # decided before the trace root existed — backdate it
+                    # into the tree as the admission stage
+                    tracer.record("proxy.admission", adm_ms,
+                                  _ctx=obs_context.child())
                 resp = await self._route(req)
             status = resp.status
             return resp
@@ -1625,7 +1640,28 @@ class DDSRestServer:
                     # plus the collector-fed Watchtower's verdicts
                     tid = req.query.get("trace_id") or None
                     return Response.json(self._fleet.fleet_incidents(tid))
+                if arg == "profile":
+                    # Chronoscope rollup: every host's dds_pipe_* gauges
+                    # (carried by the shipped metrics_text) merged into
+                    # the fleet-wide bottleneck-stage verdict
+                    self._sample_state_gauges()
+                    return Response.json(self._fleet.fleet_profile())
                 return Response(404)
+
+            case ("GET", "profile") if self.cfg.profile_route_enabled:
+                # Chronoscope (obs/chronoscope): the per-route/per-stage
+                # critical-path profile + slow-trace exemplars. ?fmt=folded
+                # serves flamegraph folded text instead of the JSON
+                # waterfall. Admission-exempt like /slo: the profile must
+                # answer while the pipe is the problem.
+                from dds_tpu.obs.chronoscope import chronoscope
+
+                if req.query.get("fmt") == "folded":
+                    return Response(
+                        200, chronoscope.folded().encode(),
+                        content_type="text/plain; charset=utf-8",
+                    )
+                return Response.json(chronoscope.profile())
 
             case ("GET", "_trace") if self.cfg.trace_route_enabled:
                 # live observability (SURVEY §5.5): per-span timing summary
@@ -1818,6 +1854,17 @@ class DDSRestServer:
             # Spyglass gauges: dds_search_{index_keys,index_packs,
             # pending_ingest,...}, per group at scrape time
             self._search.export_gauges(metrics)
+        # Chronoscope pipe profile (dds_pipe_*): per-route/per-stage
+        # critical-path self-times, plus the fold-coalescer's queue depth
+        # (entries parked awaiting the adaptive window)
+        from dds_tpu.obs.chronoscope import chronoscope
+        chronoscope.export_gauges(metrics)
+        metrics.set(
+            "dds_queue_depth",
+            sum(len(g) for g in self._fold_pending.values()),
+            queue="fold-coalescer",
+            help="entries waiting in a bounded pipeline queue",
+        )
         # SLO burn/budget gauges + audit backlog (scrape-time freshness is
         # all a gauge promises; the violation COUNTER increments at
         # detection time in the auditor itself)
@@ -2118,7 +2165,12 @@ class DDSRestServer:
                 self._folds_inflight -= 1
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._fold_pending.setdefault(modulus, []).append((operands, fut))
+        # carry the waiter's trace context + enqueue time into the drain:
+        # the dispatcher runs under the DRAINER task's context, so the
+        # per-waiter coalesce-wait/fold spans must be re-homed explicitly
+        self._fold_pending.setdefault(modulus, []).append(
+            (time.perf_counter(), operands, fut, obs_context.current())
+        )
         if self._fold_drainer is None or self._fold_drainer.done():
             self._fold_drainer = supervised_task(self._drain_folds(),
                                                  name="proxy.fold_drainer")
@@ -2146,8 +2198,16 @@ class DDSRestServer:
             )
 
     async def _dispatch_fold_group(self, modulus: int, group: list) -> None:
-        folds = [ops_ for ops_, _ in group]
-        futs = [f for _, f in group]
+        folds = [ops_ for _, ops_, _, _ in group]
+        futs = [f for _, _, f, _ in group]
+        t_start = time.perf_counter()
+        for t_enq, ops_, _, wctx in group:
+            # each waiter's sat-in-the-window time, in ITS OWN trace
+            tracer.record(
+                "proxy.coalesce_wait", (t_start - t_enq) * 1e3,
+                _ctx=obs_context.child(wctx) if wctx is not None else None,
+                batch=len(group), k=len(ops_),
+            )
         self._folds_inflight += 1
         try:
             total = sum(len(f) for f in folds)
@@ -2166,6 +2226,16 @@ class DDSRestServer:
             else:
                 results = await asyncio.to_thread(
                     self.backend.modmul_fold_many, folds, modulus
+                )
+            t_done = time.perf_counter()
+            for (_, ops_, _, wctx), _r in zip(group, results):
+                # the shared device dispatch, visible from every waiter's
+                # waterfall (self-time classifies as dispatch/execute)
+                tracer.record(
+                    "proxy.coalesced_fold", (t_done - t_start) * 1e3,
+                    _ctx=obs_context.child(wctx) if wctx is not None
+                    else None,
+                    batch=len(group), k=len(ops_),
                 )
             for f, r in zip(futs, results):
                 if not f.cancelled():

@@ -12,6 +12,8 @@
 - `obs.panopticon` — fleet-wide plane: cross-host span shipping, the
   proxy-side collector (stitch + Watchtower replay), federated
   metrics/SLO, and incident correlation.
+- `obs.chronoscope` — critical-path attribution + per-route/per-stage
+  pipe profiling over finished (local or stitched) trace trees.
 
 `flight` and `kprof` import `utils/trace`, which imports `obs.context` —
 so this package eagerly exposes only the leaf modules and lazily resolves
@@ -23,13 +25,13 @@ from dds_tpu.obs.metrics import Registry, metrics  # noqa: F401
 
 __all__ = [
     "context", "metrics", "Registry", "flight", "kprof",
-    "watchtower", "slo", "sentry", "panopticon",
+    "watchtower", "slo", "sentry", "panopticon", "chronoscope",
 ]
 
 
 def __getattr__(name):
     if name in ("flight", "kprof", "watchtower", "slo", "sentry",
-                "panopticon"):
+                "panopticon", "chronoscope"):
         import importlib
 
         return importlib.import_module(f"{__name__}.{name}")

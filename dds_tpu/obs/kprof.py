@@ -10,6 +10,14 @@ into distinct tracer spans and metrics histograms; `cache_event`/`counted`
 account compile-cache hits vs misses for the manual dict caches
 (ops/foldmany) and `functools.lru_cache`d builders (ops/mont_mxu).
 
+Cold calls split further: a compile-cache MISS (correlated by cache name,
+or any miss landing during the dispatch window) marks the next
+`profiled()` call for that kernel as a compile, and its host-side phase
+records as `kernel.<name>.compile` INSTEAD of `.dispatch` — so dispatch
+stats stay warm-only and Chronoscope's dispatch stage is never polluted
+by one-time trace+compile time (which gets its own trace-compile stage).
+
+
 `kernel_summary()` condenses both for benchmark records
 (benchmarks/common.emit attaches it to every row in results.json).
 """
@@ -19,6 +27,7 @@ from __future__ import annotations
 import threading
 import time
 
+from dds_tpu.obs import context as obs_context
 from dds_tpu.obs.metrics import metrics
 from dds_tpu.utils.trace import tracer
 
@@ -26,6 +35,10 @@ __all__ = ["cache_event", "counted", "profiled", "kernel_summary", "reset"]
 
 _lock = threading.Lock()
 _cache_stats: dict[str, list[int]] = {}  # cache name -> [hits, misses]
+# cache names that missed since their last profiled() call: builder
+# caches fire BEFORE the dispatch (the builder returns the jitted fn),
+# so the miss is remembered until the matching kernel dispatches
+_pending_compile: set[str] = set()
 
 
 def cache_event(cache: str, hit: bool) -> None:
@@ -33,6 +46,8 @@ def cache_event(cache: str, hit: bool) -> None:
     with _lock:
         s = _cache_stats.setdefault(cache, [0, 0])
         s[0 if hit else 1] += 1
+        if not hit:
+            _pending_compile.add(cache)
     metrics.inc(
         "dds_compile_cache_total", cache=cache,
         outcome="hit" if hit else "miss",
@@ -51,24 +66,51 @@ def counted(cache: str, lru_fn, *args):
 
 def profiled(kernel: str, dispatch, **meta):
     """Run `dispatch()` (enqueue device work, return jax arrays) and time
-    its two phases separately: the dispatch call (which *includes*
-    trace+compile on a compile-cache miss) and the `block_until_ready`
-    device execution. Both land as `kernel.<name>.dispatch` /
-    `kernel.<name>.execute` spans plus metrics histograms; returns the
-    (ready) dispatch result."""
+    its two phases separately: the host-side call and the
+    `block_until_ready` device execution. A cold call — its builder cache
+    missed (by name) since the last dispatch, or any cache miss landed
+    DURING the dispatch window — records its host phase as
+    `kernel.<name>.compile`; warm calls record `.dispatch`. Both pair
+    with `kernel.<name>.execute` spans plus metrics histograms; returns
+    the (ready) dispatch result."""
     import jax
 
+    with _lock:
+        compiled = kernel in _pending_compile
+        _pending_compile.discard(kernel)
+        misses0 = sum(m for _, m in _cache_stats.values())
     t0 = time.perf_counter()
     out = dispatch()
     t1 = time.perf_counter()
     jax.block_until_ready(out)
     t2 = time.perf_counter()
-    tracer.record(f"kernel.{kernel}.dispatch", (t1 - t0) * 1e3, **meta)
-    tracer.record(f"kernel.{kernel}.execute", (t2 - t1) * 1e3, **meta)
-    metrics.observe(
-        "dds_kernel_dispatch_seconds", t1 - t0, kernel=kernel,
-        help="host-side dispatch time (includes trace+compile on cache miss)",
+    with _lock:
+        compiled = compiled or (
+            sum(m for _, m in _cache_stats.values()) > misses0
+        )
+    # fresh child contexts: each phase record is its own span in the
+    # trace tree, not a clone of the enclosing span's identity
+    cur = obs_context.current()
+    phase = "compile" if compiled else "dispatch"
+    tracer.record(
+        f"kernel.{kernel}.{phase}", (t1 - t0) * 1e3,
+        _ctx=obs_context.child(cur) if cur is not None else None, **meta,
     )
+    tracer.record(
+        f"kernel.{kernel}.execute", (t2 - t1) * 1e3,
+        _ctx=obs_context.child(cur) if cur is not None else None, **meta,
+    )
+    if compiled:
+        metrics.observe(
+            "dds_kernel_compile_seconds", t1 - t0, kernel=kernel,
+            help="host-side trace+compile time on compile-cache misses",
+        )
+    else:
+        metrics.observe(
+            "dds_kernel_dispatch_seconds", t1 - t0, kernel=kernel,
+            help="host-side dispatch time (warm calls only; cold calls "
+                 "record dds_kernel_compile_seconds)",
+        )
     metrics.observe(
         "dds_kernel_execute_seconds", t2 - t1, kernel=kernel,
         help="device execute time (block_until_ready)",
@@ -77,8 +119,9 @@ def profiled(kernel: str, dispatch, **meta):
 
 
 def kernel_summary() -> dict:
-    """{spans, compile_cache, dispatch_ms, execute_ms} over kernel.* spans
-    recorded so far — the per-record accounting benchmarks attach."""
+    """{spans, compile_cache, dispatch_ms, execute_ms, compile_ms} over
+    kernel.* spans recorded so far — the per-record accounting
+    benchmarks attach."""
     spans = {
         name: stats
         for name, stats in tracer.summary().items()
@@ -99,14 +142,19 @@ def kernel_summary() -> dict:
     execute_ms = sum(
         s["total_ms"] for n, s in spans.items() if n.endswith(".execute")
     )
+    compile_ms = sum(
+        s["total_ms"] for n, s in spans.items() if n.endswith(".compile")
+    )
     return {
         "spans": spans,
         "compile_cache": caches,
         "dispatch_ms": round(dispatch_ms, 3),
         "execute_ms": round(execute_ms, 3),
+        "compile_ms": round(compile_ms, 3),
     }
 
 
 def reset() -> None:
     with _lock:
         _cache_stats.clear()
+        _pending_compile.clear()

@@ -496,6 +496,11 @@ class FleetCollector:
         self.tracer = tracer if tracer is not None else default_tracer
         self.metrics = registry if registry is not None else default_metrics
         self.slo = slo  # the proxy's own SloEngine (local source)
+        # Chronoscope (or None): fed each stitched tree at replay time so
+        # the proxy's pipe profile sees remote replica/ingest spans too.
+        # Deploy detaches the Chronoscope from the raw tracer and parks it
+        # here — a trace is profiled exactly once, stitched.
+        self.profiler = None
         self.addr = net.local_addr(COLLECTOR_ENDPOINT)
         # trace_id -> {"records": [SpanRecord], "root": SpanRecord | None,
         #              "due": monotonic | None, "first": monotonic}
@@ -656,6 +661,11 @@ class FleetCollector:
             for rec in buf["records"]:
                 self.watchtower.on_record(rec)
             self.watchtower.on_record(buf["root"])
+            if self.profiler is not None:
+                try:
+                    self.profiler.ingest_tree(buf["records"] + [buf["root"]])
+                except Exception:  # noqa: BLE001 — profiling never breaks stitching
+                    log.exception("chronoscope stitched-tree ingest failed")
             self.traces_stitched += 1
             self.metrics.inc(
                 "dds_fleet_traces_stitched_total",
@@ -809,6 +819,70 @@ class FleetCollector:
                 "shed_level_max": max(shed.values(), default=0.0),
             },
         }
+
+    def fleet_profile(self) -> dict:
+        """The `GET /fleet/profile` body: every host's Chronoscope pipe
+        profile (carried as `dds_pipe_*` gauges inside the shipped
+        metrics_text — zero wire-format changes) rolled up per route.
+
+        Rollup semantics: a stage's fleet p95 is the MAX across hosts —
+        stages run on different processes (proxy coalesce vs replica
+        apply vs group ingest), so the worst host's self-time is the
+        fleet's bottleneck candidate, not an average that would dilute a
+        single hot shard. `top` names the single (route, stage) pair with
+        the largest p95 self-time fleet-wide."""
+        hosts: dict = {}
+        routes: dict = {}
+        for r in self._source_rows():
+            hrow = hosts.setdefault(r["host"], {
+                "role": r["role"], "shard": r["shard"],
+                "region": r.get("region", ""),
+                "age_s": round(r["age_s"], 3), "stale": r["stale"],
+                "routes": {},
+            })
+            text = r["metrics_text"]
+            for labels, v in parse_samples(text, "dds_pipe_wall_p95_ms"):
+                route = labels.get("route", "-")
+                hrow["routes"].setdefault(route, {})["wall_p95_ms"] = v
+                agg = routes.setdefault(route, {
+                    "wall_p95_ms": 0.0, "coverage_min": None, "stages": {},
+                })
+                agg["wall_p95_ms"] = max(agg["wall_p95_ms"], v)
+            for labels, v in parse_samples(text, "dds_pipe_coverage"):
+                route = labels.get("route", "-")
+                hrow["routes"].setdefault(route, {})["coverage"] = v
+                agg = routes.setdefault(route, {
+                    "wall_p95_ms": 0.0, "coverage_min": None, "stages": {},
+                })
+                cur = agg["coverage_min"]
+                agg["coverage_min"] = v if cur is None else min(cur, v)
+            for labels, v in parse_samples(text, "dds_pipe_stage_p95_ms"):
+                route = labels.get("route", "-")
+                stage = labels.get("stage", "other")
+                agg = routes.setdefault(route, {
+                    "wall_p95_ms": 0.0, "coverage_min": None, "stages": {},
+                })
+                st = agg["stages"].setdefault(
+                    stage, {"p95_ms": 0.0, "host": None})
+                if v >= st["p95_ms"]:
+                    st["p95_ms"], st["host"] = v, r["host"]
+        top = None
+        for route, agg in routes.items():
+            best = None
+            for stage, st in agg["stages"].items():
+                if stage == "other":
+                    continue  # the unattributed residue is not a bottleneck NAME
+                if best is None or st["p95_ms"] > best[1]:
+                    best = (stage, st["p95_ms"], st["host"])
+            if best is not None:
+                agg["top_stage"] = {
+                    "stage": best[0], "p95_ms": round(best[1], 3),
+                    "host": best[2],
+                }
+                if top is None or best[1] > top["p95_ms"]:
+                    top = {"route": route, "stage": best[0],
+                           "p95_ms": round(best[1], 3), "host": best[2]}
+        return {"hosts": hosts, "fleet": {"routes": routes, "top": top}}
 
     def fleet_incidents(self, trace_id: str | None = None) -> dict:
         """The `GET /fleet/incidents` body: shipped incident-index entries

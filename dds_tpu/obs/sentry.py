@@ -14,7 +14,13 @@ Baseline file schema (JSON):
     {"version": 1, "updated": <unix ts>, "kernels": {
         "<kernel>[k=...,R=...]": {
             "dispatch": {"p50_ms": ..., "p95_ms": ..., "count": N},
-            "execute":  {"p50_ms": ..., "p95_ms": ..., "count": N}}}}
+            "execute":  {"p50_ms": ..., "p95_ms": ..., "count": N},
+            "compile":  {"p50_ms": ..., "p95_ms": ..., "count": N}}}}
+
+The "compile" phase (cold trace+compile calls, split out of dispatch by
+obs/kprof) is OPTIONAL per entry: baselines written before the split
+stay valid, and entries missing a phase on either side simply skip that
+phase's comparison.
 
 Kernels are keyed by execution platform (`platform()`, e.g. `cpu::` /
 `tpu::`) plus name plus the shape-ish span meta (`k`, `R`, `P2`) so a
@@ -39,7 +45,7 @@ __all__ = [
     "baseline_path", "persist_from_tracer", "platform",
 ]
 
-PHASES = ("dispatch", "execute")
+PHASES = ("dispatch", "execute", "compile")
 # span meta keys that describe the kernel's shape (batch width, request
 # fan-in, padded sizes) — part of the baseline key, never averaged across
 SHAPE_KEYS = ("k", "K", "R", "P2", "L")

@@ -43,6 +43,7 @@ from dds_tpu.ops import bignum as bn
 from dds_tpu.ops.flags import karatsuba_mode
 from dds_tpu.ops.montgomery import ModCtx
 from dds_tpu.resident.pool import ResidentPool
+from dds_tpu.utils.queues import TimedQueue
 
 KERNELS = ("jnp", "v1", "v2")
 
@@ -168,8 +169,9 @@ class ResidentPlane:
         self._lock = threading.Lock()
         self._pools: dict[tuple[str, int], ResidentPool] = {}
         self._order: dict[str, int] = {}  # gid -> mesh slice index
-        self._pending: dict[str, list[int]] = {}  # gid -> queued write ingests
-        self._dropped_pending = 0
+        # queued (gid, cipher) write ingests; enqueue-timestamped so the
+        # drain can attribute ingest-queue-wait, drops reason-labelled
+        self._pending = TimedQueue("lodestone-ingest", maxlen=self.max_pending)
 
     # ------------------------------------------------------------- topology
 
@@ -209,36 +211,37 @@ class ResidentPlane:
         this group's existing pools (every modulus a past aggregate has
         established). Returns how many were queued; with no pool for the
         group yet there is nothing to convert against — the first
-        aggregate ingests as before (a cold fleet stays cold-path)."""
+        aggregate ingests as before (a cold fleet stays cold-path, but
+        the skipped entries are COUNTED as reason="no_pool" drops rather
+        than vanishing silently). A full queue rejects with
+        reason="full"; a dropped entry just re-ingests lazily at the
+        next fold."""
         if not ciphers:
             return 0
         with self._lock:
-            if not any(g == gid for g, _ in self._pools):
-                return 0
-            q = self._pending.setdefault(gid, [])
-            room = self.max_pending - sum(
-                len(v) for v in self._pending.values()
-            )
-            take = ciphers[: max(0, room)]
-            q.extend(take)
-            dropped = len(ciphers) - len(take)
-            if dropped:  # bounded queue: a dropped entry just re-ingests
-                self._dropped_pending += dropped  # lazily at the next fold
-            return len(take)
+            has_pool = any(g == gid for g, _ in self._pools)
+        if not has_pool:
+            self._pending.drop(len(ciphers), reason="no_pool")
+            return 0
+        return self._pending.offer_many((gid, c) for c in ciphers)
 
     def pending_ingest(self) -> int:
-        with self._lock:
-            return sum(len(v) for v in self._pending.values())
+        return self._pending.depth()
 
     def ingest_pending(self) -> int:
         """Drain the write-ingest queue into the matching pools (run on a
         worker thread, coalesced by the proxy exactly like folds).
         Returns rows newly ingested across all pools."""
+        batch = self._pending.drain()
+        if not batch:
+            return 0
         with self._lock:
-            batch, self._pending = self._pending, {}
             pools = list(self._pools.items())
+        by_gid: dict[str, list[int]] = {}
+        for gid, cipher in batch:
+            by_gid.setdefault(gid, []).append(cipher)
         grew = 0
-        for gid, ciphers in batch.items():
+        for gid, ciphers in by_gid.items():
             for (g, _mod), pool in pools:
                 if g == gid:
                     grew += pool.ingest(ciphers)
@@ -300,13 +303,14 @@ class ResidentPlane:
         """Per-pool view for GET /health."""
         with self._lock:
             pools = dict(self._pools)
-            pending = sum(len(v) for v in self._pending.values())
+        pending = self._pending.depth()
         return {
             "kernel": self.kernel,
             "mesh_devices": (
                 int(self.mesh.devices.size) if self.mesh is not None else 1
             ),
             "pending_ingest": pending,
+            "dropped_pending": self._pending.dropped(),
             "pools": [
                 {"shard": gid or "-", "modulus_bits": mod.bit_length(),
                  **pool.stats()}
@@ -319,7 +323,9 @@ class ResidentPlane:
     def export_gauges(self, registry=metrics) -> None:
         """Scrape-time gauges: dds_resident_{rows,bytes,hit_ratio,resets}
         aggregated per shard label (pools for several moduli sum; the hit
-        ratio weights by operands served)."""
+        ratio weights by operands served), plus the write-ingest queue's
+        dds_queue_* family."""
+        self._pending.export_gauges(registry)
         with self._lock:
             pools = list(self._pools.items())
         per_gid: dict[str, list] = {}

@@ -32,10 +32,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from dds_tpu.obs import context as obs_context
 from dds_tpu.obs import kprof
 from dds_tpu.obs.metrics import metrics
 from dds_tpu.ops import bignum as bn
@@ -221,11 +223,25 @@ class ResidentPool:
             [c % self.modulus for c in missing], self._ctx.L
         )
         pre = {c: converted[i] for i, c in enumerate(missing)}
+        t_h2d = time.perf_counter()
         with self._lock:
             before = self._count
             self.ensure(missing, pre)
             grew = self._count - before
         if grew:
+            # Chronoscope's host-to-device-transfer stage + bytes-moved
+            # accounting: each placed row is L limbs of 4 bytes on device
+            moved = grew * self._ctx.L * 4
+            cur = obs_context.current()
+            tracer.record(
+                "ingest.h2d", (time.perf_counter() - t_h2d) * 1e3,
+                _ctx=obs_context.child(cur) if cur is not None else None,
+                rows=grew, bytes=moved, shard=self.gid or "-",
+            )
+            metrics.inc(
+                "dds_ingest_h2d_bytes_total", moved, shard=self.gid or "-",
+                help="bytes placed into device-resident pools (rows*L*4)",
+            )
             metrics.inc(
                 "dds_resident_ingest_total", grew, shard=self.gid or "-",
                 path="write",

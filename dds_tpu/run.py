@@ -69,6 +69,12 @@ class Deployment:
 
         if self.cfg.obs.audit_enabled:
             watchtower.detach()
+        # Chronoscope is a process-wide singleton like the Watchtower:
+        # detach so a later deployment (or test) starts with a clean feed
+        from dds_tpu.obs.chronoscope import chronoscope
+
+        chronoscope.detach()
+        chronoscope.reset()
 
 
 async def launch(cfg: DDSConfig | None = None) -> Deployment:
@@ -519,6 +525,11 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             check_quorum=cfg.obs.audit_quorum_checks and all_local,
         )
         watchtower.attach(_tracer)
+    # Chronoscope rides the same process tracer (every span is local in a
+    # single-process launch); DDS_OBS_PIPE=0 keeps it dormant
+    from dds_tpu.obs.chronoscope import chronoscope
+
+    chronoscope.attach()
     return dep
 
 
@@ -770,6 +781,9 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
             )) if cfg.geo.enabled and cfg.geo.lease_ttl > 0 else None,
         )
         watchtower.attach(_tracer)
+    from dds_tpu.obs.chronoscope import chronoscope
+
+    chronoscope.attach()
     return dep
 
 

@@ -43,6 +43,7 @@ import threading
 import numpy as np
 
 from dds_tpu.models.det import DetKey
+from dds_tpu.utils.queues import TimedQueue
 
 _HOST_OPS = {
     "gt": operator.gt,
@@ -303,10 +304,12 @@ class SearchPlane:
     def __init__(self, max_pending: int = 8192):
         self._lock = threading.Lock()
         self._groups: dict[str, GroupIndex] = {}
-        self._pending: list[tuple] = []
+        # queued (gid, key, tag, value) updates; enqueue-timestamped so
+        # the drain attributes ingest-queue-wait, full-queue drops are
+        # reason-labelled (the key reads stale and repairs at next query)
+        self._pending = TimedQueue("spyglass-ingest", maxlen=max_pending)
         self.max_pending = max_pending
         self._ingested = 0
-        self._dropped = 0
         self._invalidations = 0
 
     def group(self, gid: str) -> GroupIndex:
@@ -328,22 +331,17 @@ class SearchPlane:
     def note_write(self, gid: str, key: str, tag, value) -> bool:
         """Queue one committed write for ingest; False = queue full (the
         key will read as stale and be repaired at the next query)."""
-        with self._lock:
-            if len(self._pending) >= self.max_pending:
-                self._dropped += 1
-                return False
-            self._pending.append((gid, key, tag, value))
-            return True
+        return self._pending.offer((gid, key, tag, value))
 
     def pending_ingest(self) -> int:
-        return len(self._pending)
+        return self._pending.depth()
 
     def ingest_pending(self) -> int:
-        with self._lock:
-            batch, self._pending = self._pending, []
+        batch = self._pending.drain()
         for gid, key, tag, value in batch:
             self.group(gid).upsert(key, tag, value)
-        self._ingested += len(batch)
+        with self._lock:
+            self._ingested += len(batch)
         return len(batch)
 
     # ---------------------------------------------------- direct mutation
@@ -366,8 +364,8 @@ class SearchPlane:
         op provenance is in doubt — rebuild from quorum reads)."""
         with self._lock:
             groups = list(self._groups.values())
-            self._pending.clear()
             self._invalidations += 1
+        self._pending.clear(reason="invalidated")
         for g in groups:
             g.clear()
 
@@ -376,23 +374,23 @@ class SearchPlane:
     def stats(self) -> dict:
         with self._lock:
             groups = dict(self._groups)
-            pending = len(self._pending)
         return {
             "groups": {
                 gid or "-": {"keys": len(g), "packs": g.pack_count()}
                 for gid, g in groups.items()
             },
             "indexed_keys": sum(len(g) for g in groups.values()),
-            "pending_ingest": pending,
+            "pending_ingest": self._pending.depth(),
             "ingested": self._ingested,
-            "dropped": self._dropped,
+            "dropped": self._pending.dropped("full"),
             "invalidations": self._invalidations,
         }
 
     def export_gauges(self, registry) -> None:
         """Scrape-time `dds_search_*` gauges (the Lodestone convention:
         per-group series labelled shard=gid, '-' for the unsharded
-        group)."""
+        group), plus the ingest queue's dds_queue_* family."""
+        self._pending.export_gauges(registry)
         st = self.stats()
         for gid, g in st["groups"].items():
             registry.set("dds_search_index_keys", g["keys"], shard=gid,

@@ -59,6 +59,23 @@ def start_steering():
     return task
 
 
+def capture_exemplar(res: dict):
+    """Chronoscope-style slow-trace exemplar capture, sanctioned shape:
+    called from a tracer subscriber that may run ON the event-loop
+    thread, so the blocking flight write is dispatched as a supervised
+    task through the async recorder; only off-loop callers would write
+    synchronously."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        flight.record("slow_trace", trace_id=res["trace_id"])
+        return None
+    return supervised_task(
+        flight.record_async("slow_trace", trace_id=res["trace_id"]),
+        name="fixture.exemplar",
+    )
+
+
 async def lease_keeper_loop(client):
     """Atlas-style read-local lease session keeper, sanctioned shape:
     the renewal loop is spawned supervised, the session state it mutates
