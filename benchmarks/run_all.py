@@ -24,7 +24,7 @@ def main(argv=None):
                             mixed, multihost_load, overload_goodput,
                             pipe_profile, product, put_concurrency,
                             resident_fold, search_latency, shard_scaling,
-                            sweep)
+                            sweep, tenant_isolation)
 
     rows = []
     if args.quick:
@@ -41,6 +41,11 @@ def main(argv=None):
         rows += overload_goodput.main(
             ["--duration", "1.5", "--keys", "32", "--bits", "1024",
              "--interactive-rate", "15", "--aggregate-rate", "120"]
+        )
+        rows += tenant_isolation.main(
+            ["--duration", "1.5", "--tenants", "4", "--keys-per-tenant", "4",
+             "--interactive-rate", "24", "--flood-rate", "32",
+             "--bits", "512", "--repeats", "1"]
         )
         rows += multihost_load.main(
             ["--rates", "40,100", "--duration", "1.5", "--keys", "24"]
@@ -75,6 +80,7 @@ def main(argv=None):
         rows += shard_scaling.main([])
         rows += analytics_matvec.main([])
         rows += overload_goodput.main([])
+        rows += tenant_isolation.main([])
         rows += multihost_load.main([])
         rows += fleet_obs_overhead.main([])
         rows += pipe_profile.main([])

@@ -14,12 +14,13 @@ Modes (all emit one JSON line to stdout):
         `fleet obs` (benchmarks/fleet_obs_overhead.py),
         `pipe profile` (benchmarks/pipe_profile.py),
         `decrypt throughput` (benchmarks/decrypt_throughput.py),
-        `search latency` (benchmarks/search_latency.py) and
-        `autoscale goodput` (benchmarks/autoscale_goodput.py) records
+        `search latency` (benchmarks/search_latency.py),
+        `autoscale goodput` (benchmarks/autoscale_goodput.py) and
+        `tenant isolation` (benchmarks/tenant_isolation.py) records
         in benchmarks/results.json / results_quick.json so a malformed
         scaling, analytics, overload, multihost, fleet-obs, pipe,
-        resident, decrypt, search or autoscale record is caught by the
-        same smoke.
+        resident, decrypt, search, autoscale or tenant record is
+        caught by the same smoke.
         Exit 0 on valid (or absent) files, 2 on a malformed one.
 
     python benchmarks/sentry.py --record [--baseline PATH] [--repeats N]
@@ -489,6 +490,46 @@ def _check_pipe_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_tenant_records(root: str = REPO) -> dict:
+    """Validate `tenant isolation` rows (benchmarks/tenant_isolation.py):
+    positive victim-p95 value and a detail block carrying both variants'
+    p95s (the blast-radius comparison the record exists for), a numeric
+    degradation percentage (any sign — best-of runs can come out
+    faster), the flooder's shed census (non-negative 429 count bounded
+    by its request count, which must be positive or the run flooded
+    nothing), at least two tenants, and the open-loop flag. Same
+    malformed contract as the other row families: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("tenant isolation")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("victim_p95_base_ms"), (int, float))
+            and detail["victim_p95_base_ms"] > 0
+            and isinstance(detail.get("victim_p95_flood_ms"), (int, float))
+            and detail["victim_p95_flood_ms"] > 0
+            and isinstance(detail.get("degradation_pct"), (int, float))
+            and isinstance(detail.get("flooder_requests"), int)
+            and detail["flooder_requests"] > 0
+            and isinstance(detail.get("flooder_429"), int)
+            and 0 <= detail["flooder_429"] <= detail["flooder_requests"]
+            and isinstance(detail.get("tenants"), int)
+            and detail["tenants"] >= 2
+            and detail.get("open_loop") is True
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed tenant-isolation record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _load_fresh(path: str) -> dict:
     """A stats JSON: either the baseline schema or a bare kernels dict."""
     with open(path) as f:
@@ -539,6 +580,7 @@ def main(argv=None) -> int:
             search = _check_search_records()
             autoscale = _check_autoscale_records()
             geo = _check_geo_records()
+            tenant = _check_tenant_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -557,6 +599,7 @@ def main(argv=None) -> int:
             "search_rows": search["rows"],
             "autoscale_rows": autoscale["rows"],
             "geo_rows": geo["rows"],
+            "tenant_rows": tenant["rows"],
         }))
         return 0
 

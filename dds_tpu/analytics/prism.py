@@ -139,18 +139,21 @@ class Prism:
             groups.setdefault(self.owner(k), []).append(i)
         return list(groups.items())
 
-    def _gather(self, gid: str, sub_cs: list[int], rows: int, n2: int):
+    def _gather(self, gid: str, sub_cs: list[int], rows: int, n2: int,
+                tenant: str = ""):
         """Resident device rows for one group's operand columns, or None
         when residency does not apply: no plane, a host backend (it works
         from the ints), a below-crossover request (the host loop wins),
         or a set wider than its pool. Residency is an optimization only —
-        None always degrades to the marshaling path."""
+        None always degrades to the marshaling path. `tenant` names the
+        Bastion pool stripe the rows gather from ("" = the anonymous
+        single-tenant stripe)."""
         mdb = getattr(self.backend, "min_device_batch", None)
         if self.resident is None or mdb is None:
             return None
         if rows * len(sub_cs) < mdb:
             return None
-        return self.resident.rows_for(gid, n2, sub_cs)
+        return self.resident.rows_for(gid, n2, sub_cs, tenant)
 
     async def evaluate(
         self,
@@ -159,6 +162,7 @@ class Prism:
         ciphers: list[int],
         encoded: list[list[int]],
         n2: int,
+        tenant: str = "",
     ) -> list[int]:
         """Dispatch one request's encoded weighted fold: scatter per shard
         when the columns span groups, gather with combine_partials."""
@@ -193,7 +197,7 @@ class Prism:
                 async def one(gid: str, idxs: list[int]) -> list[int]:
                     sub_cs = [ciphers[i] for i in idxs]
                     sub_w = [[row[i] for i in idxs] for row in encoded]
-                    rows = self._gather(gid, sub_cs, R, n2)
+                    rows = self._gather(gid, sub_cs, R, n2, tenant)
                     return await asyncio.to_thread(
                         self.backend.matvec, sub_cs, sub_w, n2, rows
                     )
@@ -207,7 +211,7 @@ class Prism:
                 ]
             else:
                 gid = parts[0][0] if parts else ""
-                rows = self._gather(gid, ciphers, R, n2)
+                rows = self._gather(gid, ciphers, R, n2, tenant)
                 out = await asyncio.to_thread(
                     self.backend.matvec, ciphers, encoded, n2, rows
                 )

@@ -10,7 +10,20 @@ decision loop, sitting at the REST edge BEFORE a Deadline is minted:
 - `TokenBucket` per (tenant, priority class): a request that exceeds its
   tenant's refill rate is rejected in microseconds with 429 and a
   Retry-After equal to the bucket's actual refill ETA — the hot tenant
-  pays, everyone else keeps their budget.
+  pays, everyone else keeps their budget. With Bastion the buckets are
+  *weighted-fair*: when a class's aggregate demand exceeds its configured
+  rate, each active tenant's refill contracts to its weight share of the
+  class rate (work-conserving — under-subscribed classes leave every
+  tenant at the full rate), so a flooding tenant cannot monopolize a
+  class simply by arriving first.
+- Per-tenant burn-driven shedding: the controller tracks per-(tenant,
+  class) outcomes in the evaluation window; when the fleet's SLO burn
+  alert fires AND one tenant owns at least `tenant_burn_threshold` of
+  the window's bad outcomes, THAT tenant is shed (429s for its sheddable
+  classes) instead of ratcheting the whole fleet — a distressed tenant
+  sheds itself, not the fleet. Tenant state is bounded
+  (`max_tracked_tenants`; beyond it tenants share an "overflow" bucket
+  and attribution coarsens, but requests still serve).
 - `AdmissionController`: a shedding ratchet driven by the SLO engine's
   multiwindow burn alerts and the breaker census. Distress raises the
   shed level one class at a time (lowest priority first: background,
@@ -164,6 +177,11 @@ class AdmissionController:
         alerts: Optional[Callable[[], Iterable[str]]] = None,
         breakers: Optional[Callable[[], tuple[int, list[float]]]] = None,
         clock: Callable[[], float] = time.monotonic,
+        tenant_weights: dict[str, float] | None = None,
+        default_weight: float = 1.0,
+        tenant_burn_threshold: float = 0.5,
+        tenant_shed_hold: int = 3,
+        max_tracked_tenants: int = 1024,
     ):
         # class name -> (rate, burst); a missing class is unthrottled
         self.rates = dict(rates or {})
@@ -182,6 +200,19 @@ class AdmissionController:
         self._healthy_streak = 0
         self._last_eval = clock()
         self.transitions: list[dict] = []  # bounded history for /slo + tests
+        # ---- Bastion per-tenant state (all bounded by max_tracked_tenants)
+        self.tenant_weights = dict(tenant_weights or {})
+        self.default_weight = float(default_weight)
+        self.tenant_burn_threshold = float(tenant_burn_threshold)
+        self.tenant_shed_hold = int(tenant_shed_hold)
+        self.max_tracked_tenants = int(max_tracked_tenants)
+        self._tenants: set[str] = set()
+        # (tenant, class idx) -> [arrivals, bad outcomes] in the current
+        # evaluation window; arrivals tick in decide(), bad in note_outcome
+        self._window: dict[tuple[str, int], list] = {}
+        # tenant -> {"level": shed classes, "streak": clean evals since}
+        self._tenant_shed: dict[str, dict] = {}
+        self.tenant_transitions: list[dict] = []
         # transition subscribers (event-driven waits for harnesses and
         # tests — the sleep-free alternative to polling `transitions`);
         # invoked synchronously at transition time, exceptions swallowed
@@ -190,12 +221,14 @@ class AdmissionController:
 
     @classmethod
     def from_config(cls, acfg, alerts=None, breakers=None,
-                    clock: Callable[[], float] = time.monotonic
-                    ) -> "AdmissionController":
+                    clock: Callable[[], float] = time.monotonic,
+                    tenancy=None) -> "AdmissionController":
         """Build from an AdmissionConfig-shaped object (duck-typed so this
         module never imports the config tree — the SloEngine.from_obs
-        pattern)."""
+        pattern). `tenancy` optionally supplies a TenancyConfig-shaped
+        object for the Bastion weighted-fair / burn-shed knobs."""
         g = lambda name, dflt: getattr(acfg, name, dflt)  # noqa: E731
+        t = lambda name, dflt: getattr(tenancy, name, dflt)  # noqa: E731
         rates = {
             "interactive": (g("interactive_rate", 400.0), g("interactive_burst", 800.0)),
             "aggregate": (g("aggregate_rate", 64.0), g("aggregate_burst", 128.0)),
@@ -212,12 +245,31 @@ class AdmissionController:
             alerts=alerts,
             breakers=breakers,
             clock=clock,
+            tenant_weights=dict(t("weights", None) or {}),
+            default_weight=t("default_weight", 1.0),
+            tenant_burn_threshold=t("burn_threshold", 0.5),
+            tenant_shed_hold=t("shed_hold", 3),
+            max_tracked_tenants=t("max_tenants", 1024),
         )
 
     # ------------------------------------------------------------ decisions
 
     def route_class(self, route: str) -> int:
         return route_class(route, self.class_overrides)
+
+    def _track(self, tenant: str) -> str:
+        """Bounded tenant tracking: a tenant beyond `max_tracked_tenants`
+        folds into the shared "overflow" identity for buckets, windows,
+        and shed state (requests still serve; attribution coarsens)."""
+        if tenant in self._tenants:
+            return tenant
+        if len(self._tenants) < self.max_tracked_tenants:
+            self._tenants.add(tenant)
+            return tenant
+        return "overflow"
+
+    def weight(self, tenant: str) -> float:
+        return float(self.tenant_weights.get(tenant, self.default_weight))
 
     def _bucket(self, tenant: str, ci: int) -> TokenBucket | None:
         spec = self.rates.get(CLASSES[ci])
@@ -233,6 +285,18 @@ class AdmissionController:
         """Lowest class index currently being shed (len(CLASSES) = none)."""
         return len(CLASSES) - self.shed_level
 
+    def note_outcome(self, tenant: str, klass: str, good: bool) -> None:
+        """Per-tenant burn attribution feed: the REST edge reports how
+        each ADMITTED request actually ended (good = non-5xx within its
+        latency objective). Bad outcomes accumulate against the tenant in
+        the current evaluation window; `_evaluate_locked` uses the shares
+        to decide whether distress is one tenant's or the fleet's."""
+        ci = CLASSES.index(klass) if klass in CLASSES else len(CLASSES) - 1
+        with self._lock:
+            cell = self._window.setdefault((self._track(tenant), ci), [0, 0])
+            if not good:
+                cell[1] += 1
+
     def decide(self, route: str, tenant: str = "default") -> Decision:
         """Admit/reject one request. Called at the REST edge BEFORE a
         Deadline is minted, so every rejection costs microseconds, not a
@@ -241,6 +305,8 @@ class AdmissionController:
             self._maybe_evaluate()
             ci = self.route_class(route)
             klass = CLASSES[ci]
+            tenant = self._track(tenant)
+            self._window.setdefault((tenant, ci), [0, 0])[0] += 1
             if ci >= self._shed_floor():
                 metrics.inc("dds_admission_requests_total", outcome="shed",
                             help="admission verdicts by outcome and class",
@@ -248,6 +314,16 @@ class AdmissionController:
                 return Decision(False, 503, self._shed_retry_after(),
                                 f"shedding {klass} (level {self.shed_level})",
                                 klass)
+            tshed = self._tenant_shed.get(tenant)
+            if tshed is not None and ci >= len(CLASSES) - tshed["level"]:
+                metrics.inc("dds_admission_requests_total",
+                            outcome="tenant_shed",
+                            help="admission verdicts by outcome and class",
+                            **{"class": klass})
+                return Decision(
+                    False, 429,
+                    self.eval_interval * max(1, self.tenant_shed_hold),
+                    f"tenant {tenant!r} shed (burn-driven)", klass)
             bucket = self._bucket(tenant, ci)
             if bucket is not None and not bucket.try_acquire():
                 eta = bucket.refill_eta()
@@ -285,6 +361,7 @@ class AdmissionController:
             return self.shed_level
 
     def _evaluate_locked(self) -> None:
+        elapsed = max(1e-6, self._clock() - self._last_eval)
         self._last_eval = self._clock()
         alert_classes = {self.route_class(r) for r in self._alerts()}
         n_coord, open_etas = self._breakers()
@@ -297,8 +374,16 @@ class AdmissionController:
         # feeding that back would latch the ratchet at max forever
         serving_floor = self._shed_floor()
         slo_bad = any(ci < serving_floor for ci in alert_classes)
-        distress = breaker_bad or slo_bad
-        if distress:
+        window, self._window = self._window, {}
+        self._rebalance_locked(window, elapsed)
+        dominant = self._attribute_locked(window) if slo_bad else None
+        self._step_tenants_locked(dominant)
+        if dominant is not None and not breaker_bad:
+            # one tenant owns the burn: it has just been shed above —
+            # hold the FLEET ratchet where it is (the point of Bastion:
+            # a distressed tenant sheds itself, not everyone)
+            self._healthy_streak = 0
+        elif breaker_bad or slo_bad:
             self._healthy_streak = 0
             if self.shed_level < self.max_shed_level:
                 reason = "breakers" if breaker_bad else "slo_burn"
@@ -314,6 +399,97 @@ class AdmissionController:
         metrics.set("dds_admission_shed_level", self.shed_level,
                     help="Bulwark shed level (0=none; higher sheds lower "
                          "priority classes first)")
+        metrics.set("dds_admission_tenants_shed", len(self._tenant_shed),
+                    help="tenants currently burn-shed by Bulwark")
+
+    # ------------------------------------------------- Bastion tenant logic
+
+    def _rebalance_locked(self, window: dict, elapsed: float) -> None:
+        """Weighted-fair bucket refill: per class, when the window's
+        aggregate arrival rate exceeds the class rate, each active
+        tenant's bucket contracts to its weight share of the class rate;
+        otherwise every bucket restores to the full class rate
+        (work-conserving — fairness only costs anything under
+        contention)."""
+        for ci, klass in enumerate(CLASSES):
+            spec = self.rates.get(klass)
+            if spec is None:
+                continue
+            active = [t for (t, c), cell in window.items()
+                      if c == ci and cell[0] > 0]
+            demand = sum(window[(t, ci)][0] for t in active) / elapsed
+            contended = len(active) > 1 and demand > spec[0]
+            wsum = sum(self.weight(t) for t in active) or 1.0
+            for (t, c), bucket in self._buckets.items():
+                if c != ci:
+                    continue
+                if contended and t in active:
+                    share = self.weight(t) / wsum
+                    bucket.rate = max(1e-9, spec[0] * share)
+                    bucket.burst = max(1.0, spec[1] * share)
+                else:
+                    bucket.rate, bucket.burst = spec[0], spec[1]
+
+    def _attribute_locked(self, window: dict) -> str | None:
+        """The tenant owning >= tenant_burn_threshold of the window's bad
+        outcomes, or None when the burn is not attributable to one tenant
+        (too little signal, or spread across tenants). The "default"
+        tenant is never self-shed — in single-tenant deployments it IS
+        the fleet, and the global ratchet already covers that."""
+        bad: dict[str, int] = {}
+        for (t, _c), cell in window.items():
+            bad[t] = bad.get(t, 0) + cell[1]
+        total = sum(bad.values())
+        if total < 4:
+            return None
+        tenant, worst = max(bad.items(), key=lambda kv: kv[1])
+        if tenant == "default" or worst / total < self.tenant_burn_threshold:
+            return None
+        return tenant
+
+    def _step_tenants_locked(self, dominant: str | None) -> None:
+        """Shed the dominant burning tenant; age out tenants whose burn
+        stopped (tenant_shed_hold clean evaluations, same hysteresis as
+        the global ratchet)."""
+        if dominant is not None:
+            state = self._tenant_shed.get(dominant)
+            if state is None:
+                self._tenant_shed[dominant] = {
+                    "level": max(1, self.max_shed_level), "streak": 0,
+                }
+                self._tenant_transition(dominant, "shed", "tenant_burn")
+            else:
+                state["streak"] = 0
+        for tenant in list(self._tenant_shed):
+            if tenant == dominant:
+                continue
+            state = self._tenant_shed[tenant]
+            state["streak"] += 1
+            if state["streak"] >= self.tenant_shed_hold:
+                del self._tenant_shed[tenant]
+                self._tenant_transition(tenant, "unshed", "recovered")
+
+    def _tenant_transition(self, tenant: str, direction: str,
+                           reason: str) -> None:
+        record = {"at": self._clock(), "tenant": tenant,
+                  "direction": direction, "reason": reason}
+        self.tenant_transitions.append(record)
+        del self.tenant_transitions[:-64]
+        tracer.event("admission.tenant_" + direction, tenant=tenant,
+                     reason=reason)
+        metrics.inc("dds_admission_tenant_transitions_total",
+                    direction=direction,
+                    help="Bulwark per-tenant burn-shed transitions")
+        from dds_tpu.obs.flight import flight
+
+        flight.record(f"admission_tenant_{direction}", tenant=tenant,
+                      reason=reason)
+
+    def shed_tenants(self) -> list[str]:
+        """Tenants currently burn-shed (Helmsman's tenant-attribution
+        signal rides on this plus SloEngine.tenant_burns)."""
+        with self._lock:
+            return sorted(self._tenant_shed)
 
     def subscribe(self, fn) -> None:
         """Register a transition observer: `fn(record)` fires on every
@@ -383,6 +559,13 @@ class AdmissionController:
                 "healthy_streak": self._healthy_streak,
                 "shed_hold": self.shed_hold,
                 "transitions": list(self.transitions[-8:]),
+                "tenants": {
+                    "tracked": len(self._tenants),
+                    "max_tracked": self.max_tracked_tenants,
+                    "shed": sorted(self._tenant_shed),
+                    "burn_threshold": self.tenant_burn_threshold,
+                    "transitions": list(self.tenant_transitions[-8:]),
+                },
             }
 
 

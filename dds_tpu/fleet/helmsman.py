@@ -59,6 +59,7 @@ class Helmsman:
         pool_pressure=None,          # () -> 0..1 resident-pool occupancy
         source_ages=None,            # () -> {gid: seconds since heartbeat}
         regions=None,                # () -> {gid: home region} (Atlas)
+        tenant_burns=None,           # () -> {tenant: burn} (Bastion)
         # ---- actions (async callables) ----
         split=None,                  # async (gid) -> None
         merge=None,                  # async (gid) -> None
@@ -87,6 +88,7 @@ class Helmsman:
         self._pool_pressure = pool_pressure
         self._source_ages = source_ages
         self._regions = regions
+        self._tenant_burns = tenant_burns
         self._regions_down: set = set()  # regions currently declared dead
         self._split = split
         self._merge = merge
@@ -214,6 +216,20 @@ class Helmsman:
         pool = self._pool_pressure() if self._pool_pressure else 0.0
         detail = {"slo_alerts": alerts, "shed_level": shed,
                   "open_breakers": len(etas), "pool_pressure": round(pool, 3)}
+        # Bastion attribution: when one tenant dominates the burn, every
+        # decision this tick records WHO drove it — a split announced as
+        # "tenant X's burn" is the runbook difference between adding
+        # capacity and asking why X floods (Bulwark sheds X either way)
+        if self._tenant_burns is not None:
+            try:
+                burns = {t: float(b) for t, b
+                         in dict(self._tenant_burns()).items() if b > 0}
+            except Exception:  # noqa: BLE001 — a broken signal never blocks
+                burns = {}
+            if burns:
+                top = max(burns, key=burns.get)
+                detail["tenant"] = top
+                detail["tenant_burn"] = round(burns[top], 3)
         return bool(alerts or shed > 0 or pool >= 0.9), detail
 
     def _dead_regions(self, ages: dict, known: set) -> dict:

@@ -49,6 +49,7 @@ from dds_tpu.core.errors import (
     WrongShardError,
 )
 from dds_tpu.core.quorum_client import AbdClient
+from dds_tpu.core.tenant import DEFAULT_TENANT, TenantError, validate_tenant
 from dds_tpu.http import json_protocol as J
 from dds_tpu.utils.tasks import supervised_task
 from dds_tpu.http.miniserver import HttpServer, Request, Response, http_request
@@ -75,6 +76,13 @@ log = logging.getLogger("dds.rest")
 # deadline PROPAGATION without threading a parameter through 23 routes.
 _REQ_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
     "dds_request_deadline", default=None
+)
+
+# The current request's validated tenant (Bastion) — set in handle() next
+# to the deadline, read by the ownership checks and the data-plane helpers
+# so tenancy needs no parameter threading through 23 routes either.
+_REQ_TENANT: contextvars.ContextVar = contextvars.ContextVar(
+    "dds_request_tenant", default=DEFAULT_TENANT
 )
 
 # transient storage-layer failures worth retrying; anything else (a
@@ -213,6 +221,15 @@ class ProxyConfig:
     # microseconds, BEFORE a Deadline is minted. None/disabled = the
     # pre-Bulwark behavior (every request admitted).
     admission: object = None
+    # Bastion multi-tenancy (core/tenant, models/tenancy): a TenancyConfig-
+    # shaped object with enabled=True makes the x-dds-tenant header an
+    # isolation boundary — per-tenant key ownership (cross-tenant access
+    # answers a typed 403), tenant-striped Lodestone pools and Spyglass
+    # indexes, tenant-filtered aggregates/analytics, weighted-fair
+    # admission with burn-driven per-tenant shedding, and per-tenant
+    # SLO/usage attribution. None/disabled = the single-tenant behavior
+    # byte-for-byte (every plane call maps to the anonymous "" stripe).
+    tenancy: object = None
     # Lodestone resident ciphertext plane (dds_tpu/resident): a
     # ResidentConfig-shaped object with enabled=True pins per-shard-group
     # ciphertext limb pools device-side, ingests committed writes off the
@@ -401,12 +418,28 @@ class DDSRestServer:
         # census; and the adaptive coalescing window sized from observed
         # fold arrivals. Both None when admission is off — every gate
         # below is a cheap is-None check.
+        # Bastion (core/tenant + models/tenancy): tenancy makes the
+        # validated x-dds-tenant header an isolation boundary. The server
+        # holds NO tenant keys (the TenantKeyring is client-side, like the
+        # Sanctum decrypt plane) — its tenancy surface is ownership
+        # enforcement (typed 403s), plane striping, tenant-filtered
+        # aggregates, and attribution. `_tenant_owner` maps each stored
+        # key to the tenant whose PutSet claimed it; it persists inside
+        # the stored-keys snapshot (backward-compatible: legacy list
+        # snapshots load as ownerless keys).
+        tcfg = self.cfg.tenancy
+        self._tenancy_enabled = bool(
+            tcfg is not None and getattr(tcfg, "enabled", False)
+        )
+        self._tenant_owner: dict[str, str] = {}
+        self._tenant_pairs_memo: dict[str, tuple] = {}
         acfg = self.cfg.admission
         self.admission: AdmissionController | None = None
         self._coalescer: AdaptiveCoalescer | None = None
         if acfg is not None and getattr(acfg, "enabled", False):
             self.admission = AdmissionController.from_config(
                 acfg, alerts=self.slo.alerts, breakers=self._breaker_census,
+                tenancy=(tcfg if self._tenancy_enabled else None),
             )
             if getattr(acfg, "adaptive_coalesce", True) and self.cfg.coalesce_window > 0:
                 self._coalescer = AdaptiveCoalescer(
@@ -483,12 +516,21 @@ class DDSRestServer:
         except (OSError, ValueError) as e:
             log.warning("ignoring unreadable stored-keys snapshot %s: %s", p, e)
             return
+        owners = {}
+        if isinstance(keys, dict):
+            # Bastion snapshot shape: {"keys": [...], "tenants": {key: t}}
+            owners = keys.get("tenants") or {}
+            keys = keys.get("keys")
         if not isinstance(keys, list):  # hand-edited / corrupted snapshot
             log.warning("ignoring malformed stored-keys snapshot %s", p)
             return
         for k in keys:
             if isinstance(k, str):
                 self.stored_keys.add(k)
+        if isinstance(owners, dict):
+            for k, t in owners.items():
+                if isinstance(k, str) and isinstance(t, str):
+                    self._tenant_owner[k] = t
         self._stored_version += 1
         log.info("recovered %d stored keys from %s", len(self.stored_keys), p)
 
@@ -501,10 +543,17 @@ class DDSRestServer:
 
         self._keys_dirty = False
         p = pathlib.Path(self.cfg.keys_path)
+        if self._tenant_owner:
+            # ownership rides the snapshot: a restarted proxy must keep
+            # refusing cross-tenant access to keys written before the crash
+            body = {"keys": sorted(self.stored_keys),
+                    "tenants": dict(self._tenant_owner)}
+        else:
+            body = sorted(self.stored_keys)  # legacy shape, byte-identical
         try:
             p.parent.mkdir(parents=True, exist_ok=True)
             tmp = p.with_name(p.name + ".tmp")
-            tmp.write_text(_json.dumps(sorted(self.stored_keys)))
+            tmp.write_text(_json.dumps(body))
             os.replace(tmp, p)
         except OSError as e:
             log.warning("stored-keys snapshot to %s failed: %s", p, e)
@@ -637,6 +686,95 @@ class DDSRestServer:
             self._stored_version += 1
             self._save_keys_soon()
 
+    # ------------------------------------------------------ Bastion tenancy
+
+    def _req_tenant(self) -> str:
+        """The current request's validated tenant (helpers invoked outside
+        a request context — tests, background tasks — read the default)."""
+        return _REQ_TENANT.get()
+
+    def _plane_tenant(self, tenant: str | None = None) -> str:
+        """Tenant id as the data planes see it: the default tenant maps to
+        the anonymous "" stripe, so single-tenant deployments keep their
+        pool keys, group indexes, and gauge label sets byte-identical."""
+        if not self._tenancy_enabled:
+            return ""
+        t = tenant if tenant is not None else _REQ_TENANT.get()
+        return "" if t == DEFAULT_TENANT else t
+
+    def _key_tenant(self, key: str) -> str | None:
+        """The tenant a key belongs to. Stored keys without an ownership
+        record are legacy (pre-Bastion) data and belong to the default
+        tenant; keys neither recorded nor stored are unclaimed (None) —
+        free for any tenant's first write to claim."""
+        t = self._tenant_owner.get(key)
+        if t is not None:
+            return t
+        return DEFAULT_TENANT if key in self.stored_keys else None
+
+    def _note_owner(self, key: str) -> None:
+        """Record the writing tenant as `key`'s owner (first writer wins;
+        _tenant_denied refuses the write before this runs otherwise)."""
+        if not self._tenancy_enabled:
+            return
+        tenant = _REQ_TENANT.get()
+        if self._tenant_owner.get(key) != tenant:
+            self._tenant_owner[key] = tenant
+            self._tenant_pairs_memo.clear()
+            self._save_keys_soon()
+
+    def _tenant_denied(self, *keys: str) -> Response | None:
+        """Typed 403 when the request's tenant owns none of `keys` it
+        touches; None admits. Unclaimed keys admit (a first PutSet claims
+        one; reads of a nonexistent key 404 as always); stored keys
+        without a record are legacy data under the default tenant, and
+        nowhere else. The refusal is explicit and attributed: requests
+        are NEVER silently served another tenant's ciphertexts."""
+        if not self._tenancy_enabled:
+            return None
+        tenant = _REQ_TENANT.get()
+        for key in keys:
+            owner = self._key_tenant(key)
+            if owner is not None and owner != tenant:
+                metrics.inc(
+                    "dds_tenant_denied_total", tenant=tenant,
+                    help="cross-tenant key accesses refused with 403",
+                )
+                flight.record("tenant_denied", tenant=tenant, key=key)
+                return Response.json(
+                    {"error": "cross-tenant access denied",
+                     "tenant": tenant, "key": key},
+                    status=403,
+                )
+        return None
+
+    def _tenant_pairs(self, pairs: list[tuple[str, list]]) -> list:
+        """The aggregate/search view filtered to the request tenant's own
+        records (tenancy off = the full view, same list identity — every
+        downstream pairs-identity memo stays warm). Memoized per (tenant,
+        pairs identity): between writes each tenant's filtered view is
+        state-identical, and its stable identity is what the operand and
+        column memos key on."""
+        if not self._tenancy_enabled:
+            return pairs
+        tenant = _REQ_TENANT.get()
+        memo = self._tenant_pairs_memo.get(tenant)
+        if memo is not None and memo[0] is pairs:
+            return memo[1]
+        own = self._key_tenant
+        filtered = [(k, v) for k, v in pairs if own(k) == tenant]
+        self._tenant_pairs_memo[tenant] = (pairs, filtered)
+        return filtered
+
+    def _tenant_stored_keys(self) -> list[str]:
+        """Sorted stored keys scoped to the request tenant (the Spyglass
+        query universe); tenancy off = all stored keys, as before."""
+        if not self._tenancy_enabled:
+            return sorted(self.stored_keys)
+        tenant = _REQ_TENANT.get()
+        own = self._key_tenant
+        return sorted(k for k in self.stored_keys if own(k) == tenant)
+
     def _agg_state(self):
         """(state, keys, cached, digest, fingerprint, cached_tags) for the
         current aggregate view, memoized per (stored, cache) version."""
@@ -700,7 +838,7 @@ class DDSRestServer:
         if not ciphers:
             return
         gid = self.abd.owner(key) if self._shards is not None else ""
-        if plane.note_write(gid, ciphers):
+        if plane.note_write(gid, ciphers, tenant=self._plane_tenant()):
             self._resident_ingest_soon()
 
     def _resident_ingest_soon(self) -> None:
@@ -730,7 +868,8 @@ class DDSRestServer:
         if plane is None or not self._search_write_ingest:
             return
         gid = self.abd.owner(key) if self._shards is not None else ""
-        if plane.note_write(gid, key, tag, value):
+        if plane.note_write(gid, key, tag, value,
+                            tenant=self._plane_tenant()):
             self._search_ingest_soon()
 
     def _search_ingest_soon(self) -> None:
@@ -767,14 +906,15 @@ class DDSRestServer:
         validated index entry, so indexed results are bit-for-bit the
         legacy scan's."""
         plane = self._search
-        keys = sorted(self.stored_keys)
+        pt = self._plane_tenant()
+        keys = self._tenant_stored_keys()
         if not keys:
             return keys
         cached: list[str] = []
         cached_tags: list = []
         missing: list[str] = []
         for k in keys:
-            t = plane.tag(self._search_owner(k), k)
+            t = plane.tag(self._search_owner(k), k, tenant=pt)
             if t is None:
                 missing.append(k)
             else:
@@ -812,7 +952,7 @@ class DDSRestServer:
                 if isinstance(r, Exception):
                     raise r
                 value, tag, _coord = r
-                plane.upsert(self._search_owner(k), k, tag, value)
+                plane.upsert(self._search_owner(k), k, tag, value, tenant=pt)
         metrics.inc(
             "dds_search_index_total", max(0, len(keys) - len(stale)),
             outcome="hit", help="Spyglass index keys per query by outcome",
@@ -846,11 +986,14 @@ class DDSRestServer:
         if not keys:
             return []
         parts = self._spy_partition(keys)
+        pt = self._plane_tenant()
         with tracer.span("proxy.search_eval", k=len(keys),
                          shards=len(parts)):
             sets = await asyncio.gather(
                 *(
-                    asyncio.to_thread(evalfn, self._search.group(gid))
+                    asyncio.to_thread(
+                        evalfn, self._search.group(gid, tenant=pt)
+                    )
                     for gid in parts
                 )
             )
@@ -869,12 +1012,14 @@ class DDSRestServer:
         if not keys:
             return []
         parts = self._spy_partition(keys)
+        pt = self._plane_tenant()
         with tracer.span("proxy.search_eval", k=len(keys),
                          shards=len(parts)):
             runs = await asyncio.gather(
                 *(
                     asyncio.to_thread(
-                        self._search.group(gid).eval_order, pos, descending
+                        self._search.group(gid, tenant=pt).eval_order,
+                        pos, descending,
                     )
                     for gid in parts
                 )
@@ -919,7 +1064,7 @@ class DDSRestServer:
                 await self._spy_order(pos, descending), page
             )
         self._count_search(name, "legacy")
-        pairs = await self._fetch_stored()
+        pairs = await self._fetch_visible()
         # records without the column are EXCLUDED (the Search* convention)
         # instead of the old silent float("-inf") coercion; non-integer
         # columns raise -> 400, like every Search* int cast
@@ -944,7 +1089,7 @@ class DDSRestServer:
             )
             return self._page_response(keyset, page)
         self._count_search(name, "legacy")
-        pairs = await self._fetch_stored()
+        pairs = await self._fetch_visible()
         keyset = [
             k for k, v in pairs
             if pos < len(v) and DetKey.compare(str(v[pos]), item) == want_eq
@@ -965,7 +1110,7 @@ class DDSRestServer:
             )
             return self._page_response(keyset, page)
         self._count_search(name, "legacy")
-        pairs = await self._fetch_stored()
+        pairs = await self._fetch_visible()
         op = {
             "SearchGt": lambda e: e > item,
             "SearchGtEq": lambda e: e >= item,
@@ -986,7 +1131,7 @@ class DDSRestServer:
             )
             return self._page_response(keyset, page)
         self._count_search("Range", "legacy")
-        pairs = await self._fetch_stored()
+        pairs = await self._fetch_visible()
         keyset = [
             k for k, v in pairs
             if pos < len(v) and lo_bound <= int(v[pos]) <= hi_bound
@@ -1009,7 +1154,7 @@ class DDSRestServer:
             )
             return self._page_response(keyset, page)
         self._count_search(name, "legacy")
-        pairs = await self._fetch_stored()
+        pairs = await self._fetch_visible()
         if mode == "all":
             keyset = [
                 k for k, v in pairs
@@ -1022,6 +1167,13 @@ class DDSRestServer:
                 if any(DetKey.compare(str(e), q) for q in vals for e in v)
             ]
         return self._page_response(keyset, page)
+
+    async def _fetch_visible(self) -> list[tuple[str, list]]:
+        """`_fetch_stored` scoped to the request tenant (Bastion): the
+        quorum/tag machinery still validates the FULL stored view (one
+        shared round, whoever asks), then the tenant filter projects the
+        caller's own records. Tenancy off returns the identical list."""
+        return self._tenant_pairs(await self._fetch_stored())
 
     async def _fetch_stored(self) -> list[tuple[str, list]]:
         """Every stored (key, value), for the aggregate/search routes.
@@ -1275,14 +1427,39 @@ class DDSRestServer:
             await asyncio.sleep(interval)
             self.admission.evaluate()
 
+    def _tenant_reject(self, e: TenantError, route: str,
+                       method: str) -> Response:
+        """Typed 400 for a malformed x-dds-tenant header: charset and
+        length are clamped at the edge so wire garbage never becomes a
+        metrics label, a pool stripe, or an ownership identity — and a
+        garbled id never silently falls back into another keyspace."""
+        metrics.inc(
+            "dds_http_requests_total", route=route or "root",
+            method=method, status="400",
+            help="REST requests by route and status",
+        )
+        metrics.inc(
+            "dds_tenant_header_rejects_total", reason=e.reason,
+            help="malformed x-dds-tenant headers refused with 400",
+        )
+        return Response.json(
+            {"error": "invalid tenant header", "reason": e.reason},
+            status=400,
+        )
+
     async def handle(self, req: Request) -> Response:
         route = req.path.split("/", 2)[1] if "/" in req.path else req.path
+        header = self.admission.tenant_header \
+            if self.admission is not None else "x-dds-tenant"
+        try:
+            tenant = validate_tenant(req.headers.get(header))
+        except TenantError as e:
+            return self._tenant_reject(e, route, req.method)
         adm_ms = None
+        decision = None
         if self.admission is not None and route not in _ADMISSION_EXEMPT:
             t_adm = time.perf_counter()
-            decision = self.admission.decide(
-                route, req.headers.get(self.admission.tenant_header, "default")
-            )
+            decision = self.admission.decide(route, tenant)
             adm_ms = (time.perf_counter() - t_adm) * 1e3
             if not decision.admitted:
                 return self._admission_reject(decision, route, req.method)
@@ -1296,6 +1473,7 @@ class DDSRestServer:
         # the context var, so nested retries and per-attempt timeouts all
         # shrink toward the same edge deadline
         token = _REQ_DEADLINE.set(Deadline(self.cfg.request_budget))
+        ttoken = _REQ_TENANT.set(tenant)
         t0 = time.perf_counter()
         status = 500
         try:
@@ -1340,6 +1518,7 @@ class DDSRestServer:
             return Response(500)
         finally:
             _REQ_DEADLINE.reset(token)
+            _REQ_TENANT.reset(ttoken)
             dur = time.perf_counter() - t0
             metrics.observe(
                 "dds_http_request_seconds", dur,
@@ -1355,7 +1534,21 @@ class DDSRestServer:
                 # a 304 is a deliberately-parked gossip long-poll (or a
                 # free freshness probe) — its held duration is the design,
                 # not latency badness, so it must not burn SLO budget
-                self.slo.observe(route or "root", status, dur)
+                self.slo.observe(
+                    route or "root", status, dur,
+                    tenant=(tenant if self._tenancy_enabled else None),
+                )
+            if self._tenancy_enabled:
+                # Bastion attribution: the admitted request's outcome
+                # feeds the burn-shed window (a flooding tenant's 5xxs
+                # accumulate against ITS identity, not the fleet's), and
+                # Chronoscope's per-tenant usage ledger
+                if decision is not None:
+                    self.admission.note_outcome(
+                        tenant, decision.klass, status < 500
+                    )
+                from dds_tpu.obs.chronoscope import chronoscope
+                chronoscope.note_usage(tenant, route or "root", dur)
 
     def _unavailable(self, why: str, eta: float | None = None) -> Response:
         return Response(
@@ -1373,6 +1566,8 @@ class DDSRestServer:
 
         match (m, name):
             case ("GET", "GetSet") if arg:
+                if (denied := self._tenant_denied(arg)) is not None:
+                    return denied
                 value = await self._fetch(arg)
                 if value is None:
                     return Response(404)
@@ -1385,19 +1580,31 @@ class DDSRestServer:
                 else:
                     value = J.parse_set(body)
                     key = sigs.key_from_set(value)
+                # content addressing makes cross-tenant PutSet of identical
+                # content a key collision — first writer owns, the replay
+                # by another tenant is refused like any cross-tenant access
+                if (denied := self._tenant_denied(key)) is not None:
+                    return denied
                 await self._write(key, value)
                 self._note_stored(key)
+                self._note_owner(key)
                 return Response.text(key)
 
             case ("DELETE", "RemoveSet") if arg:
+                if (denied := self._tenant_denied(arg)) is not None:
+                    return denied
                 await self._write(arg, None)
                 if arg in self.stored_keys:
                     self.stored_keys.discard(arg)  # stop aggregating/gossiping
                     self._stored_version += 1
                     self._save_keys_soon()
+                if self._tenant_owner.pop(arg, None) is not None:
+                    self._tenant_pairs_memo.clear()
                 return Response(200)
 
             case ("PUT", "AddElement") if arg:
+                if (denied := self._tenant_denied(arg)) is not None:
+                    return denied
                 item = J.parse_item(req.json())
                 value = await self._fetch(arg)
                 if value is None:
@@ -1406,6 +1613,8 @@ class DDSRestServer:
                 return Response(200)
 
             case ("GET", "ReadElement") if arg:
+                if (denied := self._tenant_denied(arg)) is not None:
+                    return denied
                 pos = self._pos(req)
                 value = await self._fetch(arg)
                 if value is None or pos > len(value) - 1:
@@ -1413,6 +1622,8 @@ class DDSRestServer:
                 return Response.json({"value": value[pos]})
 
             case ("PUT", "WriteElement") if arg:
+                if (denied := self._tenant_denied(arg)) is not None:
+                    return denied
                 pos = self._pos(req)
                 item = J.parse_item(req.json())
                 value = await self._fetch(arg)
@@ -1427,6 +1638,8 @@ class DDSRestServer:
                 return Response(200)
 
             case ("POST", "IsElement") if arg:
+                if (denied := self._tenant_denied(arg)) is not None:
+                    return denied
                 item = J.parse_item(req.json())
                 value = await self._fetch(arg)
                 if value is None:
@@ -1525,6 +1738,14 @@ class DDSRestServer:
                 }
                 if self.cfg.region:
                     health["region"] = self.cfg.region
+                if self._tenancy_enabled:
+                    # Bastion surface: ownership footprint + who is
+                    # currently shedding themselves (never the fleet)
+                    health["tenants"] = {
+                        "owned_keys": len(self._tenant_owner),
+                        "shed": (self.admission.shed_tenants()
+                                 if self.admission is not None else []),
+                    }
                 if shards is not None:
                     health["shards"] = shards
                     health["shard_epoch"] = self._shards.epoch
@@ -1812,6 +2033,16 @@ class DDSRestServer:
         )
         metrics.set("dds_stored_keys", len(self.stored_keys),
                     help="aggregate key-set size")
+        if self._tenancy_enabled and self._tenant_owner:
+            counts_t: dict[str, int] = {}
+            for k in self.stored_keys:
+                t = self._key_tenant(k)
+                counts_t[t] = counts_t.get(t, 0) + 1
+            for t, n in counts_t.items():
+                metrics.set(
+                    "dds_tenant_stored_keys", n, tenant=t,
+                    help="stored aggregate keys per tenant (proxy view)",
+                )
         if self._shards is not None:
             smap = self._shards.current()
             metrics.set("dds_shard_epoch", smap.epoch,
@@ -1941,6 +2172,8 @@ class DDSRestServer:
     async def _pair_aggregate(self, req: Request, modparam: str) -> Response:
         """`Sum` / `Mult`: combine one position of two records."""
         key1, key2 = req.query["key1"], req.query["key2"]
+        if (denied := self._tenant_denied(key1, key2)) is not None:
+            return denied
         pos = self._pos(req)
         mod = req.query.get(modparam)
         set1, set2 = await asyncio.gather(self._fetch(key1), self._fetch(key2))
@@ -1963,7 +2196,7 @@ class DDSRestServer:
         """
         pos = self._pos(req)
         mod = req.query.get(modparam)
-        pairs = await self._fetch_stored()
+        pairs = await self._fetch_visible()
         memo = self._operand_memo
         if memo is not None and memo[0] is pairs and memo[1] == pos:
             # identity match: _fetch_stored returned its memoized pairs
@@ -1996,7 +2229,8 @@ class DDSRestServer:
                                  shards=len(parts),
                                  backend=self.backend.name):
                     result = await asyncio.to_thread(
-                        self._resident.fold_groups, parts, modulus
+                        self._resident.fold_groups, parts, modulus,
+                        self._plane_tenant(),
                     )
             if result is not None:
                 return Response.json(J.value_result(str(result)))
@@ -2072,7 +2306,7 @@ class DDSRestServer:
             )
         pos = self._pos(req)
         n, n2 = self.prism.parse_nsqr(req.query["nsqr"])
-        pairs = await self._fetch_stored()
+        pairs = await self._fetch_visible()
         keys, ciphers = self._columns(pairs, pos)
         if not ciphers:
             return Response(404)
@@ -2085,7 +2319,9 @@ class DDSRestServer:
         else:  # GroupBySum: 0/1 selector rollups over record keys
             labels, rows = self.prism.selector_rows(J.parse_groups(body), keys)
         encoded = self.prism.encode_weights(rows, n, cols=len(ciphers))
-        out = await self.prism.evaluate(name, keys, ciphers, encoded, n2)
+        out = await self.prism.evaluate(
+            name, keys, ciphers, encoded, n2, tenant=self._plane_tenant()
+        )
         if name == "WeightedSum":
             return Response.json({"result": str(out[0]), "keys": keys})
         if labels is not None:

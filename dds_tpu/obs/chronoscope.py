@@ -244,6 +244,8 @@ class Chronoscope:
     MAX_TRACE_SPANS = 2048   # spans buffered per trace
     DONE_LRU = 2048          # analyzed trace ids (straggler dedup)
     MAX_ROUTES = 64          # gauge-cardinality guard
+    MAX_TENANTS = 256        # Bastion usage-ledger cardinality guard
+    MAX_TENANT_ROUTES = 16   # per-tenant route breakdown cap
 
     def __init__(self, registry=metrics, *, window_s: float = 60.0,
                  exemplars: int = 3, slow_ms: float = 50.0,
@@ -260,6 +262,7 @@ class Chronoscope:
         self._traces: collections.OrderedDict = collections.OrderedDict()
         self._done: collections.OrderedDict = collections.OrderedDict()
         self._routes: dict[str, dict] = {}
+        self._tenants: dict[str, dict] = {}
         self._attached = None
         self._last_export = 0.0
         self.traces_profiled = 0
@@ -287,8 +290,51 @@ class Chronoscope:
             self._traces.clear()
             self._done.clear()
             self._routes.clear()
+            self._tenants.clear()
             self.traces_profiled = 0
             self.traces_evicted = 0
+
+    # ------------------------------------------- Bastion usage attribution
+
+    def note_usage(self, tenant: str, route: str, dur_s: float) -> None:
+        """One served request's wall time attributed to its tenant (fed
+        from the REST edge; cheap enough for every request). Cardinality
+        is bounded: past MAX_TENANTS live tenants the rest fold into the
+        shared "overflow" row, and each tenant's route breakdown caps at
+        MAX_TENANT_ROUTES — a tenant flood can never balloon the profile
+        (the same argument as the route-gauge guard)."""
+        if not self.enabled or not tenant:
+            return
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is None:
+                if len(self._tenants) >= self.MAX_TENANTS:
+                    tenant = "overflow"
+                    st = self._tenants.get(tenant)
+                if st is None:
+                    st = self._tenants[tenant] = {
+                        "requests": 0, "seconds": 0.0, "routes": {},
+                    }
+            st["requests"] += 1
+            st["seconds"] += dur_s
+            rt = st["routes"]
+            if route in rt or len(rt) < self.MAX_TENANT_ROUTES:
+                rt[route] = rt.get(route, 0) + 1
+
+    def tenant_usage(self) -> dict:
+        """Per-tenant cumulative usage for /profile and the fleet rollup:
+        request count, attributed wall seconds, top routes."""
+        with self._lock:
+            return {
+                t: {
+                    "requests": s["requests"],
+                    "seconds": round(s["seconds"], 6),
+                    "top_routes": dict(sorted(
+                        s["routes"].items(), key=lambda kv: -kv[1]
+                    )[:4]),
+                }
+                for t, s in self._tenants.items()
+            }
 
     # ----------------------------------------------------------- ingestion
 
@@ -506,13 +552,17 @@ class Chronoscope:
 
     def profile(self) -> dict:
         """The GET /profile JSON body."""
-        return {
+        out = {
             "enabled": self.enabled,
             "window_s": self.window_s,
             "taxonomy": list(STAGES),
             "traces_profiled": self.traces_profiled,
             "routes": self._snapshot(),
         }
+        tenants = self.tenant_usage()
+        if tenants:
+            out["tenants"] = tenants
+        return out
 
     def folded(self) -> str:
         """Folded flamegraph text (route;stage <self_ms>), one line per
@@ -551,6 +601,11 @@ class Chronoscope:
                 reg.set("dds_pipe_stage_share", ss["share"],
                         route=route, stage=stage,
                         help="EWMA share of wall time per stage")
+        for t, ts in self.tenant_usage().items():
+            reg.set("dds_tenant_usage_seconds", ts["seconds"], tenant=t,
+                    help="cumulative request wall seconds per tenant")
+            reg.set("dds_tenant_usage_requests", ts["requests"], tenant=t,
+                    help="cumulative served requests per tenant")
 
     def _maybe_export(self) -> None:
         now = time.monotonic()

@@ -15,6 +15,13 @@ Design notes:
   mid-flight would corrupt rate() queries.
 - every mutation takes one short lock; the hot-path cost is a dict lookup
   and a float add, matching the tracer's "one deque append" budget.
+- label cardinality is BOUNDED per family (`max_series`, default 1024):
+  once a family holds that many distinct label sets, new label sets fold
+  into a single `overflow` series (every label value replaced by
+  "overflow") and `dds_metrics_label_overflow_total{family=...}` counts
+  the fold. Per-tenant gauges can therefore never blow up `/metrics` —
+  a wire-supplied label (tenant id, route) is a cardinality attack
+  surface, and the registry is the last line of defense.
 """
 
 from __future__ import annotations
@@ -26,6 +33,7 @@ from dataclasses import dataclass, field
 __all__ = [
     "Registry", "metrics",
     "LATENCY_BUCKETS", "SIZE_BUCKETS",
+    "OVERFLOW_LABEL", "OVERFLOW_COUNTER",
 ]
 
 # seconds: 1ms .. 10s, the REST/quorum latency range under chaos schedules
@@ -70,10 +78,15 @@ class _Family:
     samples: dict = field(default_factory=dict)
 
 
+OVERFLOW_LABEL = "overflow"
+OVERFLOW_COUNTER = "dds_metrics_label_overflow_total"
+
+
 class Registry:
-    def __init__(self):
+    def __init__(self, max_series: int = 1024):
         self._lock = threading.Lock()
         self._families: dict[str, _Family] = {}
+        self.max_series = int(max_series)
 
     # -------------------------------------------------------------- writes
 
@@ -92,11 +105,34 @@ class Registry:
             fam.help = help
         return fam
 
+    def _admit(self, fam: _Family, name: str, key: tuple) -> tuple:
+        """Cardinality guard (caller holds the lock): an already-known
+        label set, any label set while the family is under `max_series`,
+        and the overflow counter itself pass through; a NEW label set at
+        the cap folds into the family's single `overflow` series and is
+        counted in `dds_metrics_label_overflow_total{family=...}`."""
+        if (
+            not key
+            or key in fam.samples
+            or len(fam.samples) < self.max_series
+            or name == OVERFLOW_COUNTER
+        ):
+            return key
+        oc = self._family(
+            OVERFLOW_COUNTER, "counter",
+            "label sets folded into the overflow series by the per-family "
+            "cardinality cap",
+        )
+        okey = _label_key({"family": name})
+        oc.samples[okey] = oc.samples.get(okey, 0.0) + 1
+        return tuple((k, OVERFLOW_LABEL) for k, _ in key)
+
     def inc(self, name: str, n: float = 1.0, help: str = "", **labels) -> None:
         """Add `n` to a counter series (created on first touch)."""
         key = _label_key(labels)
         with self._lock:
             fam = self._family(name, "counter", help)
+            key = self._admit(fam, name, key)
             fam.samples[key] = fam.samples.get(key, 0.0) + n
 
     def set(self, name: str, value: float, help: str = "", **labels) -> None:
@@ -104,6 +140,7 @@ class Registry:
         key = _label_key(labels)
         with self._lock:
             fam = self._family(name, "gauge", help)
+            key = self._admit(fam, name, key)
             fam.samples[key] = float(value)
 
     def observe(self, name: str, value: float, buckets: tuple = LATENCY_BUCKETS,
@@ -112,6 +149,7 @@ class Registry:
         key = _label_key(labels)
         with self._lock:
             fam = self._family(name, "histogram", help, tuple(buckets))
+            key = self._admit(fam, name, key)
             s = fam.samples.get(key)
             if s is None:
                 s = fam.samples[key] = [[0] * len(fam.buckets), 0.0, 0]

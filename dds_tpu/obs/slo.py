@@ -18,6 +18,13 @@ what the budget is for.
 Time is bucketed into fixed bins (fast_window/60, floor 1 s) so a window
 sum is O(bins), state stays bounded per route, and no per-request
 timestamps are retained.
+
+Bastion addendum — per-TENANT attribution: `observe` optionally carries
+the requesting tenant, binned into a parallel bounded table (at most
+`max_tenants` tracked; beyond that, outcomes fold into the "overflow"
+tenant, so a tenant-id cardinality attack coarsens attribution instead
+of growing state). `tenant_burns()` is the Helmsman/Bulwark signal that
+says WHOSE burn it is; `report()` gains a "tenants" section.
 """
 
 from __future__ import annotations
@@ -79,6 +86,7 @@ class SloEngine:
         windows: tuple[float, float] = (300.0, 3600.0),
         burn_alert: float = 14.4,
         clock=time.monotonic,
+        max_tenants: int = 256,
     ):
         self.default = default or RouteSlo()
         self.routes = dict(routes or {})
@@ -94,6 +102,10 @@ class SloEngine:
         self._bins: dict[str, collections.deque] = collections.defaultdict(
             lambda: collections.deque(maxlen=maxbins)
         )
+        # tenant -> same bin shape (bounded: max_tenants then "overflow")
+        self.max_tenants = int(max_tenants)
+        self._tenant_bins: dict[str, collections.deque] = {}
+        self._maxbins = maxbins
         self._lock = threading.Lock()
 
     @classmethod
@@ -131,22 +143,35 @@ class SloEngine:
 
     # --------------------------------------------------------------- intake
 
-    def observe(self, route: str, status: int, dur_s: float) -> None:
+    def observe(self, route: str, status: int, dur_s: float,
+                tenant: str | None = None) -> None:
         slo = self.slo_for(route)
         err = status >= 500
         slow = dur_s * 1e3 > slo.latency_ms
         idx = int(self._clock() / self.bin_s)
         with self._lock:
-            bins = self._bins[route]
-            if not bins or bins[-1][0] != idx:
-                bins.append([idx, 0, 0, 0])
-            cur = bins[-1]
-            if err:
-                cur[3] += 1
-            elif slow:
-                cur[2] += 1
-            else:
-                cur[1] += 1
+            targets = [self._bins[route]]
+            if tenant is not None:
+                tbins = self._tenant_bins.get(tenant)
+                if tbins is None:
+                    if len(self._tenant_bins) >= self.max_tenants:
+                        tenant = "overflow"
+                        tbins = self._tenant_bins.get(tenant)
+                    if tbins is None:
+                        tbins = self._tenant_bins[tenant] = collections.deque(
+                            maxlen=self._maxbins
+                        )
+                targets.append(tbins)
+            for bins in targets:
+                if not bins or bins[-1][0] != idx:
+                    bins.append([idx, 0, 0, 0])
+                cur = bins[-1]
+                if err:
+                    cur[3] += 1
+                elif slow:
+                    cur[2] += 1
+                else:
+                    cur[1] += 1
 
     # -------------------------------------------------------------- reports
 
@@ -208,6 +233,9 @@ class SloEngine:
                 # catches the cliff, the slow one proves it is sustained
                 "alert": all(b[0] >= self.burn_alert for b in burns),
             }
+        tenants = self.tenant_report()
+        if tenants:
+            out["tenants"] = tenants
         return out
 
     def alerts(self) -> list[str]:
@@ -254,6 +282,43 @@ class SloEngine:
             out[route] = row
         return out
 
+    def tenant_burns(self) -> dict[str, list[float]]:
+        """Tenant -> [burn per window, fast first], against the DEFAULT
+        objective (tenant attribution spans routes, so the per-route
+        thresholds already shaped good/bad at observe time). The signal
+        Helmsman and dashboards use to answer WHOSE burn the fleet's
+        alert is."""
+        budget = max(1e-9, 1.0 - self.default.objective)
+        with self._lock:
+            items = [(t, list(b)) for t, b in self._tenant_bins.items()]
+        out: dict[str, list[float]] = {}
+        for tenant, bins in items:
+            row = []
+            for w in self.windows:
+                good, bad_lat, bad_err = self._window_counts(bins, w)
+                total = good + bad_lat + bad_err
+                bad = bad_lat + bad_err
+                row.append(round((bad / total) / budget if total else 0.0, 3))
+            out[tenant] = row
+        return out
+
+    def tenant_report(self) -> dict:
+        """Per-tenant window totals for /slo's "tenants" section."""
+        with self._lock:
+            items = [(t, list(b)) for t, b in self._tenant_bins.items()]
+        out: dict = {}
+        for tenant, bins in sorted(items):
+            wreport = {}
+            for w in self.windows:
+                good, bad_lat, bad_err = self._window_counts(bins, w)
+                total = good + bad_lat + bad_err
+                wreport[f"{int(w)}s"] = {
+                    "total": total, "bad": bad_lat + bad_err,
+                    "bad_latency": bad_lat, "bad_error": bad_err,
+                }
+            out[tenant] = wreport
+        return out
+
     def export_gauges(self, registry) -> None:
         """Mirror the report as scrape-time gauges (http/server calls this
         from `_sample_state_gauges`)."""
@@ -277,4 +342,10 @@ class SloEngine:
             registry.set(
                 "dds_slo_alert", 1.0 if r["alert"] else 0.0, route=route,
                 help="1 when both burn windows exceed the alert threshold",
+            )
+        for tenant, row in self.tenant_burns().items():
+            registry.set(
+                "dds_slo_tenant_burn_rate", row[0], tenant=tenant,
+                help="fast-window error-budget burn rate attributed per "
+                     "tenant (bounded cardinality; overflow folds)",
             )

@@ -167,7 +167,11 @@ class ResidentPlane:
         self.max_pending = int(max_pending)
         self._reduce_factory = reduce_factory
         self._lock = threading.Lock()
-        self._pools: dict[tuple[str, int], ResidentPool] = {}
+        # (gid, tenant, modulus) -> pool: Bastion tenant striping puts the
+        # tenant id in the pool address, so one tenant overflowing its
+        # pool (capacity reset) can never reset another tenant's rows;
+        # tenant "" is the legacy/single-tenant stripe
+        self._pools: dict[tuple[str, str, int], ResidentPool] = {}
         self._order: dict[str, int] = {}  # gid -> mesh slice index
         # queued (gid, cipher) write ingests; enqueue-timestamped so the
         # drain can attribute ingest-queue-wait, drops reason-labelled
@@ -183,10 +187,10 @@ class ResidentPlane:
             for gid in gids:
                 self._order.setdefault(gid, len(self._order))
 
-    def pool(self, gid: str, modulus: int) -> ResidentPool:
+    def pool(self, gid: str, modulus: int, tenant: str = "") -> ResidentPool:
         with self._lock:
             idx = self._order.setdefault(gid, len(self._order))
-            key = (gid, modulus)
+            key = (gid, tenant, modulus)
             p = self._pools.get(key)
             if p is None:
                 from dds_tpu.parallel.mesh import group_sharding
@@ -199,31 +203,34 @@ class ResidentPlane:
                     ),
                     initial_rows=self.initial_rows,
                     max_rows=self.max_rows,
-                    gid=gid,
+                    gid=(f"{gid}|{tenant}" if tenant else gid),
                     sharding=group_sharding(self.mesh, idx, self.axis),
                 )
             return p
 
     # ----------------------------------------------------- write-path ingest
 
-    def note_write(self, gid: str, ciphers: list[int]) -> int:
+    def note_write(self, gid: str, ciphers: list[int],
+                   tenant: str = "") -> int:
         """Queue a committed write's ciphertext columns for ingest into
-        this group's existing pools (every modulus a past aggregate has
-        established). Returns how many were queued; with no pool for the
-        group yet there is nothing to convert against — the first
-        aggregate ingests as before (a cold fleet stays cold-path, but
-        the skipped entries are COUNTED as reason="no_pool" drops rather
-        than vanishing silently). A full queue rejects with
-        reason="full"; a dropped entry just re-ingests lazily at the
-        next fold."""
+        this group's existing pools FOR THIS TENANT STRIPE (every modulus
+        a past aggregate has established). Returns how many were queued;
+        with no pool for the (group, tenant) yet there is nothing to
+        convert against — the first aggregate ingests as before (a cold
+        fleet stays cold-path, but the skipped entries are COUNTED as
+        reason="no_pool" drops rather than vanishing silently). A full
+        queue rejects with reason="full"; a dropped entry just re-ingests
+        lazily at the next fold."""
         if not ciphers:
             return 0
         with self._lock:
-            has_pool = any(g == gid for g, _ in self._pools)
+            has_pool = any(
+                g == gid and t == tenant for g, t, _ in self._pools
+            )
         if not has_pool:
             self._pending.drop(len(ciphers), reason="no_pool")
             return 0
-        return self._pending.offer_many((gid, c) for c in ciphers)
+        return self._pending.offer_many((gid, tenant, c) for c in ciphers)
 
     def pending_ingest(self) -> int:
         return self._pending.depth()
@@ -237,20 +244,21 @@ class ResidentPlane:
             return 0
         with self._lock:
             pools = list(self._pools.items())
-        by_gid: dict[str, list[int]] = {}
-        for gid, cipher in batch:
-            by_gid.setdefault(gid, []).append(cipher)
+        by_stripe: dict[tuple[str, str], list[int]] = {}
+        for gid, tenant, cipher in batch:
+            by_stripe.setdefault((gid, tenant), []).append(cipher)
         grew = 0
-        for gid, ciphers in by_gid.items():
-            for (g, _mod), pool in pools:
-                if g == gid:
+        for (gid, tenant), ciphers in by_stripe.items():
+            for (g, t, _mod), pool in pools:
+                if g == gid and t == tenant:
                     grew += pool.ingest(ciphers)
         return grew
 
     # ------------------------------------------------------------ evaluation
 
     def fold_groups(
-        self, parts: list[tuple[str, list[int]]], modulus: int
+        self, parts: list[tuple[str, list[int]]], modulus: int,
+        tenant: str = "",
     ) -> int | None:
         """prod over every group's operands mod `modulus` in ONE fused
         dispatch, or None when any group's operand set cannot fit its
@@ -264,7 +272,7 @@ class ResidentPlane:
         ctx = ModCtx.make(modulus)
         bufs, idxs, total = [], [], 0
         for gid, ops in parts:
-            got = self.pool(gid, modulus).rows_for(ops)
+            got = self.pool(gid, modulus, tenant).rows_for(ops)
             if got is None:
                 return None
             buf, idx = got
@@ -283,7 +291,8 @@ class ResidentPlane:
         )
         return bn.limbs_to_int(np.asarray(out)[0])
 
-    def rows_for(self, gid: str, modulus: int, cs: list[int]):
+    def rows_for(self, gid: str, modulus: int, cs: list[int],
+                 tenant: str = ""):
         """Gathered device rows (K, L) for `cs` from this group's pool —
         the Prism MatVec operand path — or None when the set is wider
         than the pool (callers marshal host ints as before)."""
@@ -291,7 +300,7 @@ class ResidentPlane:
 
         if not cs:
             return None
-        got = self.pool(gid, modulus).rows_for(cs)
+        got = self.pool(gid, modulus, tenant).rows_for(cs)
         if got is None:
             return None
         buf, idx = got
@@ -312,13 +321,28 @@ class ResidentPlane:
             "pending_ingest": pending,
             "dropped_pending": self._pending.dropped(),
             "pools": [
-                {"shard": gid or "-", "modulus_bits": mod.bit_length(),
-                 **pool.stats()}
-                for (gid, mod), pool in sorted(
-                    pools.items(), key=lambda kv: (kv[0][0], kv[0][1])
+                {"shard": gid or "-", "tenant": tenant or "-",
+                 "modulus_bits": mod.bit_length(), **pool.stats()}
+                for (gid, tenant, mod), pool in sorted(
+                    pools.items(), key=lambda kv: (kv[0][0], kv[0][1], kv[0][2])
                 )
             ],
         }
+
+    def evict_tenant(self, tenant: str) -> int:
+        """Drop every pool in `tenant`'s stripe (the data-lifecycle half
+        of a crypto-shred: the keys are gone, so the resident rows are
+        noise — free the HBM). Returns pools dropped."""
+        with self._lock:
+            victims = [k for k in self._pools if k[1] == tenant]
+            for k in victims:
+                self._pools.pop(k, None)
+        if victims:
+            metrics.inc("dds_tenant_pool_evictions_total",
+                        n=len(victims),
+                        help="resident pools dropped by tenant eviction "
+                             "(crypto-shred data lifecycle)")
+        return len(victims)
 
     def export_gauges(self, registry=metrics) -> None:
         """Scrape-time gauges: dds_resident_{rows,bytes,hit_ratio,resets}
@@ -329,13 +353,27 @@ class ResidentPlane:
         with self._lock:
             pools = list(self._pools.items())
         per_gid: dict[str, list] = {}
-        for (gid, _mod), pool in pools:
+        per_tenant: dict[str, list] = {}
+        for (gid, tenant, _mod), pool in pools:
             agg = per_gid.setdefault(gid or "-", [0, 0, 0, [0, 0, 0]])
             agg[0] += pool.resident
             agg[1] += pool.nbytes()
             agg[2] += pool.resets
             for i in range(3):
                 agg[3][i] += pool._served[i]
+            if tenant:
+                tag = per_tenant.setdefault(tenant, [0, 0, 0])
+                tag[0] += pool.resident
+                tag[1] += pool.nbytes()
+                tag[2] += pool.resets
+        for tenant, (rows, nbytes, resets) in per_tenant.items():
+            registry.set("dds_tenant_resident_rows", rows, tenant=tenant,
+                         help="ciphertext rows resident per tenant stripe")
+            registry.set("dds_tenant_resident_bytes", nbytes, tenant=tenant,
+                         help="device bytes pinned per tenant stripe")
+            registry.set("dds_tenant_resident_resets", resets, tenant=tenant,
+                         help="pool capacity resets per tenant stripe (one "
+                              "tenant's overflow cannot reset another's)")
         for gid, (rows, nbytes, resets, served) in per_gid.items():
             registry.set("dds_resident_rows", rows, shard=gid,
                          help="ciphertext rows resident per shard group")

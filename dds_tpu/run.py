@@ -91,6 +91,14 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
         for k, v in cfg.retry.overrides_for(cfg.fabric.region).items():
             setattr(cfg.proxy, k, v)
 
+    # Bastion [tenancy]: the metrics-cardinality ceiling applies process-
+    # wide before any tenant-labeled series exists — a tenant flood must
+    # overflow into the guard bucket, never balloon the registry
+    if cfg.tenancy.enabled:
+        from dds_tpu.obs.metrics import metrics as _metrics
+
+        _metrics.max_series = int(cfg.tenancy.metrics_max_series)
+
     # Telescope wiring: hand the process-wide flight recorder its incident
     # directory (it stays disabled without one — fault-path disk writes
     # are opt-in)
@@ -435,6 +443,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             analytics_max_rows=cfg.analytics.max_rows,
             analytics_max_request_bytes=cfg.analytics.max_request_bytes,
             admission=cfg.admission,
+            tenancy=cfg.tenancy,
             resident=cfg.resident,
             search=cfg.search,
             ssl_server_context=ssl_server,
@@ -603,6 +612,7 @@ def proxy_config(cfg: DDSConfig, supervisor, ssl_server, ssl_client,
         analytics_max_rows=cfg.analytics.max_rows,
         analytics_max_request_bytes=cfg.analytics.max_request_bytes,
         admission=cfg.admission,
+        tenancy=cfg.tenancy,
         resident=cfg.resident,
         search=cfg.search,
         # operator reshape control (POST /_reshard, /_helmsman) — gated
@@ -739,6 +749,11 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
             promote=(lambda gid, c=const: c.promote(gid)),
             moved_bytes=lambda r=const.rebalancer: r.moved_bytes_total,
             reshard_busy=lambda r=const.rebalancer: r.lock.locked(),
+            # Bastion: per-tenant burn attribution on every decision —
+            # worst window per tenant, from the SLO engine's tenant bins
+            tenant_burns=(lambda s=server.slo: {
+                t: max(b) for t, b in s.tenant_burns().items() if b
+            }) if cfg.tenancy.enabled else None,
             # Atlas: gid -> home region, read live so split-born groups
             # (which inherit the victim's region) appear without rewiring
             regions=(lambda c=const: {

@@ -303,20 +303,25 @@ class SearchPlane:
 
     def __init__(self, max_pending: int = 8192):
         self._lock = threading.Lock()
-        self._groups: dict[str, GroupIndex] = {}
-        # queued (gid, key, tag, value) updates; enqueue-timestamped so
-        # the drain attributes ingest-queue-wait, full-queue drops are
-        # reason-labelled (the key reads stale and repairs at next query)
+        # (gid, tenant) -> index: the Bastion tenant stripe mirrors
+        # Lodestone's — tenant id is part of the index address, so one
+        # tenant's writes/invalidation churn cannot thrash another's
+        # packs; tenant "" is the legacy/single-tenant stripe
+        self._groups: dict[tuple[str, str], GroupIndex] = {}
+        # queued (gid, tenant, key, tag, value) updates; enqueue-
+        # timestamped so the drain attributes ingest-queue-wait, full-
+        # queue drops are reason-labelled (the key reads stale and
+        # repairs at next query)
         self._pending = TimedQueue("spyglass-ingest", maxlen=max_pending)
         self.max_pending = max_pending
         self._ingested = 0
         self._invalidations = 0
 
-    def group(self, gid: str) -> GroupIndex:
+    def group(self, gid: str, tenant: str = "") -> GroupIndex:
         with self._lock:
-            g = self._groups.get(gid)
+            g = self._groups.get((gid, tenant))
             if g is None:
-                g = self._groups[gid] = GroupIndex()
+                g = self._groups[(gid, tenant)] = GroupIndex()
             return g
 
     def register_groups(self, gids) -> None:
@@ -324,39 +329,51 @@ class SearchPlane:
             self.group(gid)
 
     def group_ids(self) -> list[str]:
-        return list(self._groups)
+        return sorted({gid for gid, _t in self._groups})
 
     # ------------------------------------------------------- write ingest
 
-    def note_write(self, gid: str, key: str, tag, value) -> bool:
+    def note_write(self, gid: str, key: str, tag, value,
+                   tenant: str = "") -> bool:
         """Queue one committed write for ingest; False = queue full (the
         key will read as stale and be repaired at the next query)."""
-        return self._pending.offer((gid, key, tag, value))
+        return self._pending.offer((gid, tenant, key, tag, value))
 
     def pending_ingest(self) -> int:
         return self._pending.depth()
 
     def ingest_pending(self) -> int:
         batch = self._pending.drain()
-        for gid, key, tag, value in batch:
-            self.group(gid).upsert(key, tag, value)
+        for gid, tenant, key, tag, value in batch:
+            self.group(gid, tenant).upsert(key, tag, value)
         with self._lock:
             self._ingested += len(batch)
         return len(batch)
 
     # ---------------------------------------------------- direct mutation
 
-    def upsert(self, gid: str, key: str, tag, value) -> None:
-        self.group(gid).upsert(key, tag, value)
+    def upsert(self, gid: str, key: str, tag, value,
+               tenant: str = "") -> None:
+        self.group(gid, tenant).upsert(key, tag, value)
 
-    def tag(self, gid: str, key: str):
-        g = self._groups.get(gid)
+    def tag(self, gid: str, key: str, tenant: str = ""):
+        g = self._groups.get((gid, tenant))
         return None if g is None else g.tag(key)
 
-    def remove(self, gid: str, key: str) -> None:
-        g = self._groups.get(gid)
+    def remove(self, gid: str, key: str, tenant: str = "") -> None:
+        g = self._groups.get((gid, tenant))
         if g is not None:
             g.remove(key)
+
+    def evict_tenant(self, tenant: str) -> int:
+        """Drop every index in `tenant`'s stripe (crypto-shred data
+        lifecycle: undecryptable entries are noise). Returns indexes
+        dropped."""
+        with self._lock:
+            victims = [k for k in self._groups if k[1] == tenant]
+            for k in victims:
+                self._groups.pop(k, None)
+        return len(victims)
 
     def invalidate(self) -> None:
         """Drop every entry and queued update (the `_flush_cache`
@@ -376,8 +393,9 @@ class SearchPlane:
             groups = dict(self._groups)
         return {
             "groups": {
-                gid or "-": {"keys": len(g), "packs": g.pack_count()}
-                for gid, g in groups.items()
+                (f"{gid or '-'}|{tenant}" if tenant else gid or "-"):
+                    {"keys": len(g), "packs": g.pack_count()}
+                for (gid, tenant), g in groups.items()
             },
             "indexed_keys": sum(len(g) for g in groups.values()),
             "pending_ingest": self._pending.depth(),
@@ -392,11 +410,28 @@ class SearchPlane:
         group), plus the ingest queue's dds_queue_* family."""
         self._pending.export_gauges(registry)
         st = self.stats()
-        for gid, g in st["groups"].items():
-            registry.set("dds_search_index_keys", g["keys"], shard=gid,
+        with self._lock:
+            groups = dict(self._groups)
+        per_shard: dict[str, list] = {}
+        per_tenant: dict[str, list] = {}
+        for (gid, tenant), g in groups.items():
+            agg = per_shard.setdefault(gid or "-", [0, 0])
+            agg[0] += len(g)
+            agg[1] += g.pack_count()
+            if tenant:
+                tag = per_tenant.setdefault(tenant, [0, 0])
+                tag[0] += len(g)
+                tag[1] += g.pack_count()
+        for gid, (keys, packs) in per_shard.items():
+            registry.set("dds_search_index_keys", keys, shard=gid,
                          help="Spyglass indexed keys per shard group")
-            registry.set("dds_search_index_packs", g["packs"], shard=gid,
+            registry.set("dds_search_index_packs", packs, shard=gid,
                          help="Spyglass built column packs per shard group")
+        for tenant, (keys, packs) in per_tenant.items():
+            registry.set("dds_tenant_search_keys", keys, tenant=tenant,
+                         help="Spyglass indexed keys per tenant stripe")
+            registry.set("dds_tenant_search_packs", packs, tenant=tenant,
+                         help="Spyglass column packs per tenant stripe")
         registry.set("dds_search_pending_ingest", st["pending_ingest"],
                      help="Spyglass write-ingest queue depth")
         registry.set("dds_search_ingest_dropped", st["dropped"],

@@ -439,6 +439,46 @@ class AdmissionConfig:
 
 
 @dataclass
+class TenancyConfig:
+    """Bastion multi-tenant isolation (per-tenant crypto domains +
+    blast-radius containment). The `x-dds-tenant` header is ALWAYS
+    validated at the REST edge (charset/length clamp, typed 400 on
+    garbage, absent = "default"); `enabled = true` additionally turns on
+    keyspace ownership enforcement (typed 403 on cross-tenant key
+    access), tenant-striped Lodestone pools and Spyglass indexes,
+    per-tenant SLO/usage attribution, and weighted-fair admission with
+    per-tenant burn-driven shedding. DEPLOY.md "Multi-tenancy (Bastion)"
+    is the runbook."""
+
+    enabled: bool = False
+    # tracked-tenant cardinality bound shared by admission state, SLO
+    # attribution, and the keyring; tenants beyond it fold into an
+    # "overflow" aggregate (requests still serve — only attribution
+    # coarsens)
+    max_tenants: int = 1024
+    # weighted-fair admission: tenant id -> relative weight; unlisted
+    # tenants get default-weight. Under class overload each tenant's
+    # bucket refill contracts to its weight share of the class rate.
+    weights: dict = field(default_factory=dict)
+    default_weight: float = 1.0
+    # per-tenant burn-driven shedding: a tenant whose bad-outcome share
+    # exceeds burn-threshold of the distress window is shed by itself
+    # (429s for its sheddable classes) for at least shed-hold clean
+    # evaluations, instead of ratcheting the whole fleet
+    burn_threshold: float = 0.5
+    shed_hold: int = 3
+    # key lifecycle: rotation grace window (seconds) during which a
+    # rotated-out epoch still decrypts (re-encrypt-on-read); key family
+    # sizes for lazily-generated tenant keyrings
+    rotation_grace: float = 300.0
+    paillier_bits: int = 2048
+    rsa_bits: int = 1024
+    # per-family metric series cap applied to the process registry
+    # (obs/metrics cardinality guard)
+    metrics_max_series: int = 1024
+
+
+@dataclass
 class CryptoConfig:
     """Sanctum secret-material execution plane (dds_tpu/sanctum): where
     computation that TOUCHES private-key material runs — today the CRT
@@ -671,6 +711,7 @@ class DDSConfig:
     search: SearchConfig = field(default_factory=SearchConfig)
     fabric: FabricConfig = field(default_factory=FabricConfig)
     helmsman: HelmsmanConfig = field(default_factory=HelmsmanConfig)
+    tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     geo: GeoConfig = field(default_factory=GeoConfig)
     retry: RetryConfig = field(default_factory=RetryConfig)
@@ -729,6 +770,7 @@ _SUBSECTIONS = {
     ("DDSConfig", "search"): SearchConfig,
     ("DDSConfig", "fabric"): FabricConfig,
     ("DDSConfig", "helmsman"): HelmsmanConfig,
+    ("DDSConfig", "tenancy"): TenancyConfig,
     ("DDSConfig", "crypto"): CryptoConfig,
     ("DDSConfig", "geo"): GeoConfig,
     ("DDSConfig", "retry"): RetryConfig,

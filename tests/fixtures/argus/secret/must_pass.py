@@ -13,3 +13,22 @@ def decrypt_batch_host(key, cs):
 def decrypt_batch_device(key, backend, cs, consts):
     n2 = key.p * key.q
     return backend.powmod_batch_with_consts(cs, key.lam, n2, consts)
+
+
+def tenant_rotate_reencrypt(old, new, cs):
+    """Bastion keyring rotation: the retiring epoch's decrypt and the
+    incoming epoch's encrypt both stay on lifetime-clean paths —
+    builtin ``pow`` end to end, so no module-wide cache ever retains a
+    tenant's modulus past its epoch."""
+    n2_old = old.p * old.q
+    plains = [pow(c, old.lam, n2_old) for c in cs]
+    n2_new = new.p * new.q
+    return [pow(1 + m * new.n, 1, n2_new) for m in plains]
+
+
+def tenant_shred(keyring, tenant):
+    """Crypto-shredding: zero-fill every key in the tenant's family.
+    Secret attributes are only ever STORED to here, never read — the
+    deletion path has no value flow for the taint engine to chase."""
+    for key in keyring.family(tenant):
+        key.p = key.q = key.lam = 0

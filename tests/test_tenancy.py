@@ -1,0 +1,387 @@
+"""Bastion tenant-isolation unit tests: crypto domains and edges.
+
+Four layers, none needing a live fleet:
+
+- `core.tenant.validate_tenant`: the wire-supplied tenant label is
+  bounded and typed-rejected BEFORE it can key any server-side state;
+- `models.tenancy.TenantKeyring`: per-tenant key families — lazy
+  onboarding, rotation with a grace window (re-encrypt-on-read), and
+  crypto-shredding as deletion, including the scrub-under-churn drill
+  (rotation and shred racing in-flight decrypt traffic) and the
+  gc/weakref residue check the Sanctum suite established;
+- the metrics registry's cardinality cap at its exact boundary (the
+  satellite: per-tenant labels must never be a memory DoS);
+- Bulwark's Bastion additions on a fake clock: weighted-fair bucket
+  contraction under contention and burn-driven tenant self-shedding;
+- the `tenant isolation` benchmark record contract in sentry --check.
+"""
+
+import gc
+import json
+import threading
+import weakref
+
+import pytest
+
+from dds_tpu.core.admission import AdmissionController
+from dds_tpu.core.tenant import (
+    DEFAULT_TENANT,
+    TenantError,
+    validate_tenant,
+)
+from dds_tpu.models.tenancy import (
+    TenantKeyError,
+    TenantKeyring,
+    TenantShredded,
+)
+from dds_tpu.obs.metrics import OVERFLOW_COUNTER, OVERFLOW_LABEL, Registry
+
+pytestmark = pytest.mark.tenancy
+
+BITS = 256  # tiny primes: lifecycle math, not crypto strength
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _keyring(**kw) -> tuple[TenantKeyring, FakeClock]:
+    clk = FakeClock()
+    kw.setdefault("paillier_bits", BITS)
+    kw.setdefault("rsa_bits", 512)
+    kw.setdefault("grace", 60.0)
+    return TenantKeyring(clock=clk, **kw), clk
+
+
+# ------------------------------------------------------- header validation
+
+
+def test_validate_tenant_empty_and_none_map_to_default():
+    assert validate_tenant(None) == DEFAULT_TENANT
+    assert validate_tenant("") == DEFAULT_TENANT
+
+
+@pytest.mark.parametrize("name", [
+    "acme", "ACME-corp", "t.0", "a" * 64, "9lives", "x_y-z.w",
+])
+def test_validate_tenant_accepts_bounded_names(name):
+    assert validate_tenant(name) == name
+
+
+@pytest.mark.parametrize("raw,reason_part", [
+    ("a" * 65, "longer than 64"),     # over-length
+    ("-leading", "must match"),       # must start alphanumeric
+    (".hidden", "must match"),
+    ("sp ace", "must match"),
+    ('quo"te', "must match"),
+    ("new\nline", "must match"),
+    ("nul\x00", "must match"),
+    ("ümlaut", "must match"),
+])
+def test_validate_tenant_rejects_typed(raw, reason_part):
+    with pytest.raises(TenantError) as ei:
+        validate_tenant(raw)
+    # the typed error carries the raw value (truncated for over-length
+    # inputs) and a reason the REST edge serializes into its 400 body
+    assert ei.value.raw.startswith(raw[:16])
+    assert reason_part in ei.value.reason
+    assert isinstance(ei.value, ValueError)
+
+
+# ------------------------------------------------------- keyring lifecycle
+
+
+def test_keyring_lazy_onboard_and_roundtrip():
+    kr, _clk = _keyring()
+    ct, ver = kr.encrypt("acme", 41)
+    assert ver == 1 and kr.version("acme") == 1
+    assert kr.decrypt("acme", ct, ver) == 41
+    assert kr.known("acme") and not kr.known("ghost")
+    with pytest.raises(TenantKeyError):
+        kr._domain("ghost", create=False)
+
+
+def test_tenants_never_share_a_modulus():
+    kr, _clk = _keyring()
+    assert kr.keys_for("a").psse.n != kr.keys_for("b").psse.n
+    # per-tenant HMAC secrets differ too (transport signing domain)
+    assert kr.hmac_secret("a") != kr.hmac_secret("b")
+
+
+def test_rotation_grace_window_reencrypt_on_read():
+    kr, clk = _keyring(grace=60.0)
+    ct1, v1 = kr.encrypt("acme", 7)
+    assert kr.rotate("acme") == 2
+    # inside grace: the old epoch still decrypts, reencrypt migrates
+    assert kr.decrypt("acme", ct1, v1) == 7
+    ct2, v2, migrated = kr.reencrypt("acme", ct1, v1)
+    assert migrated and v2 == 2
+    assert kr.decrypt("acme", ct2, v2) == 7
+    # an already-current ciphertext is handed back unchanged
+    same, ver, migrated = kr.reencrypt("acme", ct2, v2)
+    assert same == ct2 and ver == 2 and not migrated
+    # hmac family rotates with the epoch
+    kr2, _ = _keyring()
+    assert kr.hmac_secret("acme") != kr2.hmac_secret("acme")
+    # past grace: the old epoch is typed-refused, the new one lives on
+    clk.advance(61.0)
+    with pytest.raises(TenantKeyError):
+        kr.decrypt("acme", ct1, v1)
+    assert kr.decrypt("acme", ct2, v2) == 7
+
+
+def test_shred_is_terminal_typed_and_idempotent():
+    kr, _clk = _keyring()
+    ct, ver = kr.encrypt("acme", 3)
+    kr.rotate("acme")
+    summary = kr.shred("acme")
+    assert summary == {"tenant": "acme", "already": False,
+                       "epochs_scrubbed": 2}
+    for op in (lambda: kr.keys_for("acme"),
+               lambda: kr.decrypt("acme", ct, ver),
+               lambda: kr.encrypt("acme", 1),
+               lambda: kr.rotate("acme"),
+               lambda: kr.hmac_secret("acme")):
+        with pytest.raises(TenantShredded):
+            op()
+    assert kr.is_shredded("acme") and not kr.known("acme")
+    assert kr.shred("acme")["already"] is True
+    # other tenants are untouched — the blast radius IS one tenant
+    assert kr.decrypt("b", kr.encrypt("b", 5)[0]) == 5
+    stats = kr.stats()
+    assert stats["shredded"] == 1 and stats["tenants"] == 2
+
+
+def test_shred_leaves_no_reachable_key_state():
+    """The Sanctum residue discipline applied to a whole tenant domain:
+    after shred(), no strong reference to the tenant's PaillierKey (or
+    its HEKeys wrapper) survives inside the keyring, so gc reclaims the
+    secret material."""
+    kr, _clk = _keyring()
+    keys = kr.keys_for("acme")
+    kr.rotate("acme")
+    refs = [weakref.ref(keys), weakref.ref(keys.psse),
+            weakref.ref(kr.keys_for("acme")),
+            weakref.ref(kr.keys_for("acme").psse)]
+    del keys
+    kr.shred("acme")
+    gc.collect()
+    assert all(r() is None for r in refs)
+
+
+def test_keyring_capacity_is_typed_refusal():
+    kr, _clk = _keyring(max_tenants=2)
+    kr.keys_for("a")
+    kr.keys_for("b")
+    with pytest.raises(TenantKeyError, match="full"):
+        kr.keys_for("c")
+
+
+def test_scrub_under_churn_rotation_and_shred_race_decrypts():
+    """Satellite 3: rotation and crypto-shredding race in-flight decrypt
+    traffic from worker threads. Every decrypt either returns the right
+    plaintext or raises a TYPED refusal (TenantShredded/TenantKeyError)
+    — never garbage, never an untyped crash — and after the dust
+    settles the shredded tenant is terminally refused while the control
+    tenant still works."""
+    kr, _clk = _keyring(grace=60.0)
+    ct, ver = kr.encrypt("victim", 11)
+    control_ct, control_ver = kr.encrypt("control", 22)
+    stop = threading.Event()
+    outcomes: list[str] = []
+    errors: list[BaseException] = []
+
+    def churn():
+        while not stop.is_set():
+            try:
+                got = kr.decrypt("victim", ct, ver)
+                if got != 11:  # wrong-epoch garbage would be a real bug
+                    errors.append(AssertionError(f"garbage decrypt {got}"))
+                    return
+                outcomes.append("ok")
+            except (TenantShredded, TenantKeyError):
+                outcomes.append("refused")
+            except BaseException as e:  # noqa: BLE001 - the assertion
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=churn) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(3):
+            kr.rotate("victim")
+        kr.shred("victim")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+    assert "refused" in outcomes or outcomes.count("ok") > 0
+    with pytest.raises(TenantShredded):
+        kr.decrypt("victim", ct, ver)
+    assert kr.decrypt("control", control_ct, control_ver) == 22
+
+
+# ------------------------------------------------- metrics cardinality cap
+
+
+def test_registry_cap_boundary_folds_new_labels_into_overflow():
+    reg = Registry(max_series=3)
+    for t in ("a", "b", "c"):
+        reg.inc("dds_x_total", tenant=t)
+    # AT the cap: every existing series is intact and still writable
+    assert reg.value("dds_x_total", tenant="a") == 1
+    reg.inc("dds_x_total", tenant="a")
+    assert reg.value("dds_x_total", tenant="a") == 2
+    # one past the cap: the new label folds into the overflow series and
+    # the overflow counter names the family
+    reg.inc("dds_x_total", tenant="d")
+    assert reg.value("dds_x_total", tenant="d") is None
+    assert reg.value("dds_x_total", tenant=OVERFLOW_LABEL) == 1
+    assert reg.value(OVERFLOW_COUNTER, family="dds_x_total") == 1
+    # repeat offenders keep folding; existing series keep passing through
+    reg.inc("dds_x_total", tenant="e")
+    assert reg.value("dds_x_total", tenant=OVERFLOW_LABEL) == 2
+    reg.inc("dds_x_total", tenant="b")
+    assert reg.value("dds_x_total", tenant="b") == 2
+    # the cap is per family, not global
+    reg.inc("dds_y_total", tenant="d")
+    assert reg.value("dds_y_total", tenant="d") == 1
+
+
+# ------------------------------------------- Bulwark Bastion: fair + burn
+
+
+def _bulwark(clk, **kw):
+    state = {"alerts": set()}
+    kw.setdefault("rates", {"aggregate": (8.0, 8.0)})
+    c = AdmissionController(
+        eval_interval=1.0,
+        alerts=lambda: state["alerts"],
+        clock=clk,
+        **kw,
+    )
+    return c, state
+
+
+def test_weighted_fair_contracts_buckets_under_contention():
+    clk = FakeClock()
+    c, _state = _bulwark(clk, tenant_weights={"gold": 3.0},
+                         default_weight=1.0)
+    # both tenants demand far over the 8/s class rate in one window
+    for _ in range(20):
+        c.decide("SumAll", tenant="gold")
+        c.decide("SumAll", tenant="lead")
+    clk.advance(1.0)
+    c.evaluate()
+    gold = c._bucket("gold", 1)
+    lead = c._bucket("lead", 1)
+    # contention: refill contracts to the weight share of the class rate
+    assert gold.rate == pytest.approx(6.0)
+    assert lead.rate == pytest.approx(2.0)
+    # demand subsides -> work-conserving restore to the full class rate
+    clk.advance(1.0)
+    c.evaluate()
+    assert gold.rate == pytest.approx(8.0)
+    assert lead.rate == pytest.approx(8.0)
+
+
+def test_burn_shed_is_scoped_to_the_burning_tenant():
+    clk = FakeClock()
+    c, state = _bulwark(clk, rates={})
+    # the noisy tenant owns the window's bad outcomes; the SLO alert fires
+    for _ in range(6):
+        c.decide("SumAll", tenant="noisy")
+        c.note_outcome("noisy", "aggregate", good=False)
+    c.decide("SumAll", tenant="quiet")
+    c.note_outcome("quiet", "aggregate", good=True)
+    state["alerts"] = {"SumAll"}
+    clk.advance(1.0)
+    c.evaluate()
+    assert c.shed_tenants() == ["noisy"]
+    # the fleet ratchet HELD: distress was one tenant's, not everyone's
+    assert c.shed_level == 0
+    d = c.decide("SumAll", tenant="noisy")
+    assert not d.admitted and d.status == 429 and "burn-driven" in d.reason
+    assert c.decide("SumAll", tenant="quiet").admitted
+    assert c.decide("GetSet", tenant="noisy").admitted  # interactive exempt
+    # burn stops -> hysteresis ages the shed out after tenant_shed_hold
+    state["alerts"] = set()
+    for _ in range(c.tenant_shed_hold):
+        clk.advance(1.0)
+        c.evaluate()
+    assert c.shed_tenants() == []
+    assert c.decide("SumAll", tenant="noisy").admitted
+    dirs = [t["direction"] for t in c.tenant_transitions]
+    assert dirs == ["shed", "unshed"]
+
+
+def test_default_tenant_burn_ratchets_the_fleet_not_itself():
+    clk = FakeClock()
+    c, state = _bulwark(clk, rates={})
+    for _ in range(6):
+        c.decide("SumAll")
+        c.note_outcome("default", "aggregate", good=False)
+    state["alerts"] = {"SumAll"}
+    clk.advance(1.0)
+    c.evaluate()
+    # single-tenant deployments: "default" IS the fleet — the global
+    # ratchet handles it, self-shedding would be a self-DoS
+    assert c.shed_tenants() == []
+    assert c.shed_level == 1
+
+
+def test_tenant_tracking_is_bounded_by_overflow_identity():
+    clk = FakeClock()
+    c, _state = _bulwark(clk, max_tracked_tenants=2)
+    assert c._track("a") == "a"
+    assert c._track("b") == "b"
+    assert c._track("z") == "overflow"
+    assert c._track("a") == "a"  # known tenants keep their identity
+
+
+# ------------------------------------------------- benchmark record contract
+
+
+def _tenant_row(**over):
+    detail = {
+        "victim_p95_base_ms": 3.2, "victim_p95_flood_ms": 3.4,
+        "degradation_pct": 6.2, "flooder_requests": 240,
+        "flooder_429": 200, "tenants": 5, "open_loop": True,
+    }
+    detail.update(over)
+    return {"metric": "tenant isolation victim p95", "value": 3.4,
+            "unit": "ms", "vs_baseline": 1.06, "detail": detail}
+
+
+def test_sentry_check_parses_tenant_isolation_records(tmp_path):
+    from benchmarks.sentry import _check_tenant_records
+
+    bench = tmp_path / "benchmarks"
+    bench.mkdir()
+    (bench / "results.json").write_text(json.dumps([_tenant_row()]))
+    assert _check_tenant_records(str(tmp_path)) == {"rows": 1}
+
+    for bad in (
+        _tenant_row(victim_p95_base_ms=0),
+        _tenant_row(flooder_requests=0),
+        _tenant_row(flooder_429=300),      # more 429s than requests
+        _tenant_row(tenants=1),
+        _tenant_row(open_loop=False),
+        {"metric": "tenant isolation victim p95", "value": 3.4},  # no detail
+    ):
+        (bench / "results.json").write_text(json.dumps([bad]))
+        with pytest.raises(ValueError, match="tenant-isolation"):
+            _check_tenant_records(str(tmp_path))
+
+    # foreign records are not this family's problem
+    (bench / "results.json").write_text(json.dumps([{"metric": "other"}]))
+    assert _check_tenant_records(str(tmp_path)) == {"rows": 0}
