@@ -1,0 +1,232 @@
+"""Heliograph canary-plane cost + the silent-corruption drill.
+
+    python -m benchmarks.canary_overhead [--rate 120] [--duration 2]
+
+Two records, both through the full in-process stack (default 9-replica/
+quorum-5 ABD topology behind one REST proxy):
+
+- `canary overhead` — a cadence sweep: the open-loop, coordinated-
+  omission-safe load plane (fabric/loadgen) drives the same mixed
+  GetSet/WriteElement/SumAll workload once with Heliograph OFF
+  (baseline) and once per probe cadence. The number the record exists
+  for is `overhead_pct` at the DEFAULT 5 s cadence: an active canary
+  plane is supposed to cost <= 1% goodput — five golden transactions
+  every few seconds against a proxy serving hundreds of requests per
+  second is noise, and this record is where CI watches that stay true.
+  The sweep's shorter cadences show where the cost curve actually
+  starts (the rate-bounded carve-out caps the worst case).
+
+- `canary drill` — the seeded silent-corruption fault: one stored
+  Paillier ciphertext of the canary population is mutated IN PLACE on
+  every replica, PAST the transport-HMAC boundary (each replica re-MACs
+  its corrupted answer, quorums agree, `GET /GetSet` keeps serving 200
+  — every passive surface stays green). The record proves the tentpole
+  claim: decrypt-and-verify flags `wrong_answer` within a bounded
+  number of probe periods, raises a Watchtower incident, and the
+  exemplar trace id in `GET /canary` matches the incident's.
+
+Both records land via `benchmarks.common.emit`; `sentry.py --check`
+validates their shape (exit 2 on malformed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_CADENCE = 5.0
+
+
+async def _launch(cadence: float | None, *, population: int = 4,
+                  audit: bool = False):
+    from dds_tpu.run import launch
+    from dds_tpu.utils.config import DDSConfig
+
+    cfg = DDSConfig()
+    cfg.proxy.port = 0
+    cfg.recovery.enabled = False    # keep timing clean of proactive restarts
+    cfg.obs.audit_enabled = audit
+    if cadence is not None:
+        cfg.heliograph.enabled = True
+        cfg.heliograph.cadence = cadence
+        cfg.heliograph.jitter = 0.25
+        cfg.heliograph.population = population
+    return await launch(cfg)
+
+
+async def _measure(cadence: float | None, rate: float, duration: float,
+                   keys: int, seed: int) -> dict:
+    """One load point: goodput under the mixed open-loop workload with
+    the prober off (cadence None) or on at `cadence`."""
+    from dds_tpu.fabric.loadgen import OpenLoopLoad
+
+    dep = await _launch(cadence)
+    try:
+        load = OpenLoopLoad([f"127.0.0.1:{dep.server.cfg.port}"],
+                            keys=keys, seed=seed)
+        await load.seed()
+        report = await load.run(rate, duration)
+        probes, probe_ok = 0, 0
+        if dep.server.heliograph is not None:
+            led = dep.server.heliograph.ledger.report()
+            probes = led["probes_recorded"]
+            probe_ok = sum(n for k, n in led["counts"].items()
+                           if k.endswith(".ok") or k.endswith(".slow"))
+        return {
+            "cadence": cadence,
+            "good": report.good,
+            "goodput_rps": round(report.achieved_rps, 2),
+            "p95_ms": round(report.p95_ms, 3),
+            "probes": probes,
+            "probes_ok": probe_ok,
+        }
+    finally:
+        await dep.stop()
+
+
+async def _drill(cadence: float, settle: float) -> dict:
+    """Seed valid-HMAC ciphertext corruption and time its detection."""
+    import json as _json
+
+    from dds_tpu.http.miniserver import http_request
+    from dds_tpu.obs.heliograph import seed_ciphertext_corruption
+    from dds_tpu.obs.watchtower import watchtower
+
+    dep = await _launch(cadence, audit=True)
+    try:
+        h = dep.server.heliograph
+        port = dep.server.cfg.port
+
+        async def _sum_state() -> dict:
+            return h.ledger.report()["kinds"].get("sum", {})
+
+        # wait for the prober to come up green (keygen + populate + the
+        # first full probe cycle)
+        deadline = time.monotonic() + settle
+        while time.monotonic() < deadline:
+            if (await _sum_state()).get("verdict") == "ok":
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError("prober never reached a green sum probe")
+
+        cycles_before = h.cycles
+        mutated = seed_ciphertext_corruption(
+            dep.replicas, h.client.keys[0], position=2)
+        if mutated == 0:
+            raise RuntimeError("seeded fault mutated no replica")
+
+        # the passive surface stays green: the quorum read keeps serving
+        # 200 over the (valid-MAC, wrong) ciphertext
+        status, _ = await http_request(
+            "127.0.0.1", port, "GET", f"/GetSet/{h.client.keys[0]}",
+            timeout=5.0)
+        passive_green = status == 200
+
+        # ... and decrypt-and-verify catches it within bounded periods
+        deadline = time.monotonic() + settle
+        while time.monotonic() < deadline:
+            state = await _sum_state()
+            if state.get("last_failure", {}).get("verdict") == "wrong_answer":
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise RuntimeError("corruption was never detected")
+        periods = max(1, h.cycles - cycles_before + 1)
+
+        trace = state["last_failure"]["trace_id"]
+        incidents = [v for v in watchtower.verdicts()
+                     if v.invariant == "canary_wrong_answer"]
+        # the exemplar must resolve end to end: the /canary report's
+        # trace id IS the Watchtower incident's
+        status, body = await http_request(
+            "127.0.0.1", port, "GET", "/canary", timeout=5.0)
+        served = _json.loads(body.decode()) if status == 200 else {}
+        served_trace = served.get("kinds", {}).get("sum", {}).get(
+            "last_failure", {}).get("trace_id")
+        return {
+            "replicas_mutated": mutated,
+            "detected_within_periods": periods,
+            "passive_green": passive_green,
+            "verdict": "wrong_answer",
+            "trace_id": trace,
+            "watchtower_incidents": len(incidents),
+            "incident_trace_match": bool(
+                incidents and any(v.trace_id == trace for v in incidents)),
+            "exemplar_resolved": served_trace == trace,
+        }
+    finally:
+        await dep.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rate", type=float, default=120.0,
+                    help="open-loop arrival rate (req/s)")
+    ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--keys", type=int, default=48)
+    ap.add_argument("--cadences", default="5.0,1.0,0.25",
+                    help="probe cadences (s) swept against the baseline")
+    ap.add_argument("--drill-cadence", type=float, default=0.25)
+    ap.add_argument("--settle", type=float, default=20.0,
+                    help="drill wait budget for keygen/populate/detection")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+
+    from benchmarks.common import emit
+
+    cadences = [float(c) for c in args.cadences.split(",") if c.strip()]
+    if DEFAULT_CADENCE not in cadences:
+        cadences.insert(0, DEFAULT_CADENCE)
+
+    off = asyncio.run(_measure(None, args.rate, args.duration,
+                               args.keys, args.seed))
+    points = {}
+    for cadence in cadences:
+        on = asyncio.run(_measure(cadence, args.rate, args.duration,
+                                  args.keys, args.seed))
+        points[str(cadence)] = {
+            "goodput_rps": on["goodput_rps"],
+            "p95_ms": on["p95_ms"],
+            "probes": on["probes"],
+            "probes_ok": on["probes_ok"],
+            "overhead_pct": round(
+                (1.0 - on["good"] / max(1, off["good"])) * 100.0, 2),
+        }
+    at_default = points[str(DEFAULT_CADENCE)]
+
+    rows = [emit(
+        "canary overhead",
+        at_default["goodput_rps"],
+        "req/s",
+        at_default["goodput_rps"] / max(1e-9, off["goodput_rps"]),
+        rate=args.rate,
+        duration=args.duration,
+        open_loop=True,
+        default_cadence_s=DEFAULT_CADENCE,
+        overhead_pct=at_default["overhead_pct"],
+        baseline_goodput_rps=off["goodput_rps"],
+        baseline_p95_ms=off["p95_ms"],
+        cadences=points,
+    )]
+
+    drill = asyncio.run(_drill(args.drill_cadence, args.settle))
+    rows.append(emit(
+        "canary drill",
+        drill["detected_within_periods"],
+        "probe-periods",
+        1.0,
+        drill_cadence_s=args.drill_cadence,
+        **drill,
+    ))
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -19,12 +19,13 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from benchmarks import (analytics_matvec, audit_cost, autoscale_goodput,
-                            bft_sum, crossover, decrypt_throughput,
-                            encrypt_modexp, fleet_obs_overhead, geo_latency,
-                            mixed, multihost_load, overload_goodput,
-                            pipe_profile, product, put_concurrency,
-                            resident_fold, search_latency, shard_scaling,
-                            sweep, tenant_isolation)
+                            bft_sum, canary_overhead, crossover,
+                            decrypt_throughput, encrypt_modexp,
+                            fleet_obs_overhead, geo_latency, mixed,
+                            multihost_load, overload_goodput, pipe_profile,
+                            product, put_concurrency, resident_fold,
+                            search_latency, shard_scaling, sweep,
+                            tenant_isolation)
 
     rows = []
     if args.quick:
@@ -68,6 +69,10 @@ def main(argv=None):
         rows += geo_latency.main(
             ["--reads", "24", "--keys", "4", "--scale", "0.05"]
         )
+        rows += canary_overhead.main(
+            ["--rate", "40", "--duration", "1.5", "--keys", "24",
+             "--cadences", "5.0,0.5"]
+        )
     else:
         rows += sweep.main([])
         rows += product.main([])
@@ -89,6 +94,7 @@ def main(argv=None):
         rows += search_latency.main([])
         rows += autoscale_goodput.main([])
         rows += geo_latency.main([])
+        rows += canary_overhead.main([])
 
     # quick mode is a smoke pass: never clobber real baseline results
     name = "results_quick.json" if args.quick else "results.json"

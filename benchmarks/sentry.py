@@ -530,6 +530,79 @@ def _check_tenant_records(root: str = REPO) -> dict:
     return {"rows": found}
 
 
+def _check_canary_records(root: str = REPO) -> dict:
+    """Validate the Heliograph rows (benchmarks/canary_overhead.py).
+
+    `canary overhead`: positive goodput value, the open-loop flag, the
+    default cadence named, a numeric overhead percentage (any sign —
+    single-run noise can make the probed run faster), a positive
+    baseline goodput, and a non-empty cadence sweep whose every point
+    carries goodput, probe census, and its own overhead number.
+
+    `canary drill`: the detection bound the tentpole claims — the
+    seeded valid-HMAC corruption caught by decrypt-and-verify within 3
+    probe periods, on >= 1 mutated replica, with the passive surface
+    green, a Watchtower incident whose trace id matches the ledger
+    exemplar, and that exemplar resolvable via `GET /canary`. Same
+    malformed contract as the other row families: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        metric = str(row.get("metric", "")) if isinstance(row, dict) else ""
+        if metric.startswith("canary overhead"):
+            detail = row.get("detail")
+            cadences = (detail.get("cadences")
+                        if isinstance(detail, dict) else None)
+            ok = (
+                isinstance(row.get("value"), (int, float)) and row["value"] > 0
+                and isinstance(detail, dict)
+                and detail.get("open_loop") is True
+                and isinstance(detail.get("default_cadence_s"), (int, float))
+                and detail["default_cadence_s"] > 0
+                and isinstance(detail.get("overhead_pct"), (int, float))
+                and isinstance(detail.get("baseline_goodput_rps"),
+                               (int, float))
+                and detail["baseline_goodput_rps"] > 0
+                and isinstance(cadences, dict) and cadences
+                and all(
+                    isinstance(pt, dict)
+                    and isinstance(pt.get("goodput_rps"), (int, float))
+                    and isinstance(pt.get("probes"), int) and pt["probes"] >= 0
+                    and isinstance(pt.get("probes_ok"), int)
+                    and 0 <= pt["probes_ok"] <= pt["probes"]
+                    and isinstance(pt.get("overhead_pct"), (int, float))
+                    for pt in cadences.values()
+                )
+                and str(detail["default_cadence_s"]) in cadences
+            )
+        elif metric.startswith("canary drill"):
+            detail = row.get("detail")
+            ok = (
+                isinstance(row.get("value"), (int, float))
+                and 1 <= row["value"] <= 3
+                and isinstance(detail, dict)
+                and isinstance(detail.get("detected_within_periods"), int)
+                and detail["detected_within_periods"] == row["value"]
+                and isinstance(detail.get("replicas_mutated"), int)
+                and detail["replicas_mutated"] >= 1
+                and detail.get("passive_green") is True
+                and detail.get("verdict") == "wrong_answer"
+                and isinstance(detail.get("trace_id"), str)
+                and detail["trace_id"]
+                and isinstance(detail.get("watchtower_incidents"), int)
+                and detail["watchtower_incidents"] >= 1
+                and detail.get("incident_trace_match") is True
+                and detail.get("exemplar_resolved") is True
+            )
+        else:
+            continue
+        if not ok:
+            raise ValueError(
+                f"malformed canary record in {name}: {metric!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
 def _load_fresh(path: str) -> dict:
     """A stats JSON: either the baseline schema or a bare kernels dict."""
     with open(path) as f:
@@ -581,6 +654,7 @@ def main(argv=None) -> int:
             autoscale = _check_autoscale_records()
             geo = _check_geo_records()
             tenant = _check_tenant_records()
+            canary = _check_canary_records()
         except ValueError as e:
             print(json.dumps({"ok": False, "baseline": path,
                               "error": str(e)}))
@@ -600,6 +674,7 @@ def main(argv=None) -> int:
             "autoscale_rows": autoscale["rows"],
             "geo_rows": geo["rows"],
             "tenant_rows": tenant["rows"],
+            "canary_rows": canary["rows"],
         }))
         return 0
 

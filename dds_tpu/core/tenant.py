@@ -21,12 +21,21 @@ from __future__ import annotations
 
 import re
 
-__all__ = ["DEFAULT_TENANT", "TENANT_RE", "MAX_TENANT_LEN", "TenantError",
-           "validate_tenant"]
+__all__ = ["DEFAULT_TENANT", "CANARY_TENANT", "TENANT_RE", "MAX_TENANT_LEN",
+           "TenantError", "validate_tenant"]
 
 DEFAULT_TENANT = "default"
 MAX_TENANT_LEN = 64
 TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+# Heliograph's reserved canary keyspace. The leading underscore is
+# REJECTED by TENANT_RE for everyone else, which is exactly the point:
+# no wire-supplied tenant id can ever collide with (or squat on) the
+# canary keyspace; only the explicit carve-out below admits it. Canary
+# traffic is clamped like any tenant but excluded from user-facing
+# analytics, per-tenant SLO attribution, and admission fairness — see
+# http/server.py and obs/heliograph.py.
+CANARY_TENANT = "__heliograph__"
 
 
 class TenantError(ValueError):
@@ -49,6 +58,10 @@ def validate_tenant(raw: str | None) -> str:
     value = raw.strip()
     if not value:
         return DEFAULT_TENANT
+    if value == CANARY_TENANT:
+        # the one id allowed to break the leading-character rule: the
+        # prober's own requests arrive through the same REST edge
+        return value
     if len(value) > MAX_TENANT_LEN:
         raise TenantError(value[:MAX_TENANT_LEN] + "...",
                           f"longer than {MAX_TENANT_LEN} chars")

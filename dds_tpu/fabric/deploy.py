@@ -396,7 +396,8 @@ def _attach_watchtower(cfg, *, check_quorum: bool, geometry: dict) -> None:
 
 
 def _wire_helmsman(cfg, server, stoppables, *, load_census, breaker_census,
-                   split, merge, promote, rebalancer, source_ages=None):
+                   split, merge, promote, rebalancer, source_ages=None,
+                   regions=None):
     """Attach the Helmsman autoscaler to a proxy-resident server when
     [helmsman] is enabled: SLO/admission/breaker signals from the server,
     load shares from the router, actions onto the reshard controller."""
@@ -417,6 +418,14 @@ def _wire_helmsman(cfg, server, stoppables, *, load_census, breaker_census,
         promote=promote,
         moved_bytes=lambda r=rebalancer: r.moved_bytes_total,
         reshard_busy=lambda r=rebalancer: r.lock.locked(),
+        regions=regions,
+        # Heliograph: sustained canary unreachability from a region is
+        # black-box region_down/promotion evidence — the probes drive the
+        # real serving path, so they fire while heartbeats stay green
+        canary_unreachable=(lambda s=server: (
+            s.heliograph.unreachable_regions()
+            if s.heliograph is not None else set()
+        )) if cfg.heliograph.enabled else None,
     )
     if admission is not None:
         admission.subscribe(hm.on_admission)
@@ -627,6 +636,30 @@ async def _launch_group(cfg, net, stoppables, ssl_server, ssl_client,
     _start_shipper(cfg, net, namer, stoppables, role=f"group:{gid}",
                    shard=gid)
 
+    # Heliograph on the group role: a standalone prober against the
+    # configured [heliograph].targets proxies (a group process has no
+    # REST edge of its own to loop back on). Its ledger writes the
+    # process-global registry, so the dds_canary_* series ride the span
+    # shipper's metrics_text to the proxy's Panopticon rollup — the
+    # fleet's `GET /fleet/canary` federates this prober with zero extra
+    # wiring, and cross-region target entries give the fleet mutual
+    # black-box coverage (group in region A probing the proxy in B).
+    if cfg.heliograph.enabled:
+        from dds_tpu.clt.canary import parse_canary_targets
+        from dds_tpu.obs.heliograph import Heliograph
+
+        targets, bad = parse_canary_targets(cfg.heliograph.targets)
+        for entry in bad:
+            log.warning("heliograph: skipping malformed target %r", entry)
+        if targets:
+            wt = None
+            if cfg.obs.audit_enabled:
+                from dds_tpu.obs.watchtower import watchtower as wt
+            helio = Heliograph(cfg.heliograph, targets,
+                               watchtower=wt, ssl_context=ssl_client)
+            helio.start()
+            stoppables.append(_Stopper(helio.stop))
+
     dep = Deployment(cfg, net, dict(group.replicas), None, server,
                      group.trudy, ssl_client, stoppables)
     # replica spans are local but the coordinators live elsewhere, so the
@@ -774,6 +807,8 @@ async def _launch_proxy(cfg, net, stoppables, ssl_server, ssl_client):
         rebalancer=controller.rebalancer,
         source_ages=(collector.source_ages if collector is not None
                      else None),
+        regions=(collector.source_regions if collector is not None
+                 else None),
     )
 
     _identify(cfg, namer, "proxy")

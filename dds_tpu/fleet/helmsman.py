@@ -60,6 +60,7 @@ class Helmsman:
         source_ages=None,            # () -> {gid: seconds since heartbeat}
         regions=None,                # () -> {gid: home region} (Atlas)
         tenant_burns=None,           # () -> {tenant: burn} (Bastion)
+        canary_unreachable=None,     # () -> {region, ...} (Heliograph)
         # ---- actions (async callables) ----
         split=None,                  # async (gid) -> None
         merge=None,                  # async (gid) -> None
@@ -89,6 +90,7 @@ class Helmsman:
         self._source_ages = source_ages
         self._regions = regions
         self._tenant_burns = tenant_burns
+        self._canary_unreachable = canary_unreachable
         self._regions_down: set = set()  # regions currently declared dead
         self._split = split
         self._merge = merge
@@ -267,11 +269,31 @@ class Helmsman:
         promote prefers a standby homed where the dead group lived, which
         for a dead region means the cross-region takeover the drill
         exercises."""
-        if self._source_ages is None or self._promote is None:
+        if self._promote is None or (
+                self._source_ages is None
+                and self._canary_unreachable is None):
             return None
         now = self._clock()
         known = set(self._last_counts)
-        ages = dict(self._source_ages())
+        ages = dict(self._source_ages()) if self._source_ages else {}
+        # Heliograph black-box evidence: a region whose canary probes hit
+        # the sustained-unreachable streak is treated as aged-out even
+        # while its heartbeats still arrive — a process can heartbeat
+        # with its SERVING path dead (wedged event loop downstream of the
+        # edge, partitioned quorum), and the probes drive the real route.
+        # Synthesizing the age (instead of a separate path) feeds the
+        # same `_dead_regions` declaration and promotion flow the
+        # heartbeat evidence does.
+        if self._canary_unreachable is not None and self._regions is not None:
+            try:
+                down = set(self._canary_unreachable())
+            except Exception:  # noqa: BLE001 — a broken signal is silence
+                down = set()
+            if down:
+                for gid, region in dict(self._regions()).items():
+                    if region in down:
+                        ages[gid] = max(ages.get(gid, 0.0),
+                                        self.heartbeat_timeout)
         labels = self._dead_regions(ages, known)
         for gid, age in ages.items():
             if gid not in known or age < self.heartbeat_timeout:

@@ -42,14 +42,16 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from dds_tpu.core.admission import AdaptiveCoalescer, AdmissionController
+from dds_tpu.core.admission import (AdaptiveCoalescer, AdmissionController,
+                                    TokenBucket)
 from dds_tpu.core.errors import (
     AllBreakersOpenError,
     ByzantineError,
     WrongShardError,
 )
 from dds_tpu.core.quorum_client import AbdClient
-from dds_tpu.core.tenant import DEFAULT_TENANT, TenantError, validate_tenant
+from dds_tpu.core.tenant import (CANARY_TENANT, DEFAULT_TENANT, TenantError,
+                                 validate_tenant)
 from dds_tpu.http import json_protocol as J
 from dds_tpu.utils.tasks import supervised_task
 from dds_tpu.http.miniserver import HttpServer, Request, Response, http_request
@@ -101,7 +103,7 @@ _RETRYABLE = (ByzantineError, WrongShardError, asyncio.TimeoutError,
 # answering through a full shed.
 _ADMISSION_EXEMPT = frozenset({"health", "metrics", "slo", "shards",
                                "fleet", "profile", "_trace", "_reshard",
-                               "_helmsman"})
+                               "_helmsman", "canary"})
 
 
 @dataclass
@@ -252,6 +254,13 @@ class ProxyConfig:
     # with a fabric controller; drives a cross-host Rebalancer.split)
     shards_wait_cap: float = 60.0
     reshard_route_enabled: bool = False
+    # Heliograph active canary plane (dds_tpu/obs/heliograph): a
+    # HeliographConfig-shaped object with enabled=True runs a supervised
+    # prober owning the reserved __heliograph__ tenant, driving verified
+    # golden transactions against this proxy's own edge (and any
+    # configured targets). None/disabled = no prober; canary-tagged
+    # traffic is still clamped + rate-bounded at the edge either way.
+    heliograph: object = None
     ssl_server_context: object = None
     ssl_client_context: object = None
 
@@ -447,6 +456,25 @@ class DDSRestServer:
                     max_window=getattr(acfg, "coalesce_max_window", 0.02),
                     target_folds=getattr(acfg, "coalesce_target_folds", 8.0),
                 )
+        # Heliograph (obs/heliograph): the prober itself starts in
+        # start() (it needs the resolved listen port), but the canary
+        # admission carve-out exists UNCONDITIONALLY: anything claiming
+        # the __heliograph__ identity bypasses tenant-fair admission yet
+        # passes this dedicated bucket, so neither a wedged prober nor an
+        # outsider squatting on the canary tenant can self-DoS the edge
+        # (the reserved id grants zero data access beyond the canary's
+        # own keyspace — see _tenant_pairs).
+        hcfg = self.cfg.heliograph
+        self.heliograph = None
+        self._canary_bucket = TokenBucket(
+            float(getattr(hcfg, "rate", 20.0) or 20.0),
+            float(getattr(hcfg, "burst", 40.0) or 40.0),
+        )
+        # keys the canary tenant owns, tracked in BOTH tenancy modes: the
+        # aggregate/search/analytics planes must never fold canary rows
+        # into user answers (nor user rows into canary ground truth —
+        # that scoping is what makes decrypt-and-compare sound).
+        self._canary_keys: set[str] = set()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -466,8 +494,39 @@ class DDSRestServer:
         if self.admission is not None:
             self._tasks.append(supervised_task(self._admission_loop(),
                                                name="proxy.admission"))
+        hcfg = self.cfg.heliograph
+        if hcfg is not None and getattr(hcfg, "enabled", False):
+            # deferred import: the prober pulls the whole client crypto
+            # stack, which most deployments (and tests) never need
+            from dds_tpu.obs.heliograph import Heliograph
+
+            self.heliograph = Heliograph(
+                hcfg, self._canary_targets(hcfg), slo=self.slo,
+                watchtower=watchtower,
+                ssl_context=self.cfg.ssl_client_context,
+            )
+            self.heliograph.start()
+
+    def _canary_targets(self, hcfg) -> list:
+        """Probe targets: this proxy's own loopback edge first (the
+        resolved port — start() runs after the listener binds), then any
+        configured "host:port" / "region=host:port" entries — per-region
+        / per-group targeting for fleets."""
+        from dds_tpu.clt.canary import CanaryTarget, parse_canary_targets
+
+        host = self.cfg.host
+        if host in ("0.0.0.0", "::", ""):
+            host = "127.0.0.1"
+        targets = [CanaryTarget(host, self.cfg.port,
+                                region=self.cfg.region or "")]
+        extra, bad = parse_canary_targets(getattr(hcfg, "targets", []))
+        for entry in bad:
+            log.warning("heliograph: skipping malformed target %r", entry)
+        return targets + extra
 
     async def stop(self) -> None:
+        if self.heliograph is not None:
+            self.heliograph.stop()
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -714,10 +773,17 @@ class DDSRestServer:
 
     def _note_owner(self, key: str) -> None:
         """Record the writing tenant as `key`'s owner (first writer wins;
-        _tenant_denied refuses the write before this runs otherwise)."""
+        _tenant_denied refuses the write before this runs otherwise).
+        Canary ownership is tracked in BOTH tenancy modes: the visibility
+        scoping in `_tenant_pairs` / `_tenant_stored_keys` depends on it
+        (canary rows must never pollute user aggregates, untenanted
+        deployments included)."""
+        tenant = _REQ_TENANT.get()
+        if tenant == CANARY_TENANT and key not in self._canary_keys:
+            self._canary_keys.add(key)
+            self._tenant_pairs_memo.clear()
         if not self._tenancy_enabled:
             return
-        tenant = _REQ_TENANT.get()
         if self._tenant_owner.get(key) != tenant:
             self._tenant_owner[key] = tenant
             self._tenant_pairs_memo.clear()
@@ -755,9 +821,25 @@ class DDSRestServer:
         pairs identity): between writes each tenant's filtered view is
         state-identical, and its stable identity is what the operand and
         column memos key on."""
-        if not self._tenancy_enabled:
-            return pairs
         tenant = _REQ_TENANT.get()
+        if not self._tenancy_enabled:
+            # Heliograph scoping without Bastion: the canary tenant sees
+            # exactly its own population (what makes decrypt-and-compare
+            # exact) and everyone else sees everything BUT it. With no
+            # canary keys stored this is the identical list object —
+            # every pre-Heliograph memo identity stays warm.
+            if tenant != CANARY_TENANT and not self._canary_keys:
+                return pairs
+            memo = self._tenant_pairs_memo.get(tenant)
+            if memo is not None and memo[0] is pairs:
+                return memo[1]
+            ck = self._canary_keys
+            if tenant == CANARY_TENANT:
+                filtered = [(k, v) for k, v in pairs if k in ck]
+            else:
+                filtered = [(k, v) for k, v in pairs if k not in ck]
+            self._tenant_pairs_memo[tenant] = (pairs, filtered)
+            return filtered
         memo = self._tenant_pairs_memo.get(tenant)
         if memo is not None and memo[0] is pairs:
             return memo[1]
@@ -769,9 +851,14 @@ class DDSRestServer:
     def _tenant_stored_keys(self) -> list[str]:
         """Sorted stored keys scoped to the request tenant (the Spyglass
         query universe); tenancy off = all stored keys, as before."""
-        if not self._tenancy_enabled:
-            return sorted(self.stored_keys)
         tenant = _REQ_TENANT.get()
+        if not self._tenancy_enabled:
+            ck = self._canary_keys
+            if tenant == CANARY_TENANT:
+                return sorted(k for k in self.stored_keys if k in ck)
+            if not ck:
+                return sorted(self.stored_keys)
+            return sorted(k for k in self.stored_keys if k not in ck)
         own = self._key_tenant
         return sorted(k for k in self.stored_keys if own(k) == tenant)
 
@@ -1457,7 +1544,29 @@ class DDSRestServer:
             return self._tenant_reject(e, route, req.method)
         adm_ms = None
         decision = None
-        if self.admission is not None and route not in _ADMISSION_EXEMPT:
+        if tenant == CANARY_TENANT:
+            # Heliograph carve-out: canary probes must get through WHILE
+            # the fleet sheds (black-box evidence is worth the most
+            # exactly then), so they bypass tenant-fair admission — but
+            # through an explicit, rate-bounded gate: the dedicated
+            # bucket 429s anything over the configured probe budget, so
+            # the prober (or a canary-tenant squatter) can never self-DoS
+            # the edge. Rejections are typed and counted.
+            if (route not in _ADMISSION_EXEMPT
+                    and not self._canary_bucket.try_acquire()):
+                metrics.inc(
+                    "dds_canary_throttled_total", route=route or "root",
+                    help="canary requests refused by the rate-bounded "
+                         "admission carve-out",
+                )
+                eta = self._canary_bucket.refill_eta()
+                return Response(
+                    429, b"canary rate bound exceeded",
+                    headers={"Retry-After": (
+                        "60" if not math.isfinite(eta)
+                        else str(max(1, math.ceil(eta))))},
+                )
+        elif self.admission is not None and route not in _ADMISSION_EXEMPT:
             t_adm = time.perf_counter()
             decision = self.admission.decide(route, tenant)
             adm_ms = (time.perf_counter() - t_adm) * 1e3
@@ -1530,15 +1639,19 @@ class DDSRestServer:
                 method=req.method, status=str(status),
                 help="REST requests by route and status",
             )
-            if status != 304:
+            if status != 304 and tenant != CANARY_TENANT:
                 # a 304 is a deliberately-parked gossip long-poll (or a
                 # free freshness probe) — its held duration is the design,
-                # not latency badness, so it must not burn SLO budget
+                # not latency badness, so it must not burn SLO budget.
+                # Canary traffic is excluded wholesale: the prober feeds
+                # its own synthetic canary.<kind> streams from VERIFIED
+                # outcomes, and synthetic load must never dilute (or
+                # burn) user-facing route objectives.
                 self.slo.observe(
                     route or "root", status, dur,
                     tenant=(tenant if self._tenancy_enabled else None),
                 )
-            if self._tenancy_enabled:
+            if self._tenancy_enabled and tenant != CANARY_TENANT:
                 # Bastion attribution: the admitted request's outcome
                 # feeds the burn-shed window (a flooding tenant's 5xxs
                 # accumulate against ITS identity, not the fleet's), and
@@ -1599,6 +1712,9 @@ class DDSRestServer:
                     self._stored_version += 1
                     self._save_keys_soon()
                 if self._tenant_owner.pop(arg, None) is not None:
+                    self._tenant_pairs_memo.clear()
+                if arg in self._canary_keys:
+                    self._canary_keys.discard(arg)
                     self._tenant_pairs_memo.clear()
                 return Response(200)
 
@@ -1765,6 +1881,14 @@ class DDSRestServer:
                 recovery = self._recovery_status()
                 if recovery is not None:
                     health["recovery"] = recovery
+                # Heliograph surface: last probe age + per-kind verdicts,
+                # read from in-memory ledger state only. A disabled or
+                # wedged prober degrades this section to "disabled" /
+                # "stale" — it can never block or slow the health probe.
+                health["canary"] = (
+                    self.heliograph.health_section()
+                    if self.heliograph is not None else {"status": "disabled"}
+                )
                 resp = Response.json(health, status=503 if degraded else 200)
                 if degraded:
                     resp.headers["Retry-After"] = str(self._derive_retry_after())
@@ -1822,6 +1946,17 @@ class DDSRestServer:
                 (self.helmsman.pin if pin else self.helmsman.unpin)()
                 return Response.json(self.helmsman.report())
 
+            case ("GET", "canary"):
+                # Heliograph report: per-kind last verdicts/latencies,
+                # typed-outcome counts, failure exemplars (trace ids
+                # resolve via /_trace and /fleet/incidents), region
+                # unreachable streaks. Admission-exempt like /health —
+                # the canary view must answer while the canary is the
+                # only thing still seeing the problem.
+                if self.heliograph is None:
+                    return Response.json({"enabled": False})
+                return Response.json(self.heliograph.report())
+
             case ("GET", "slo") if self.cfg.slo_route_enabled:
                 # per-route objective/burn state (obs/slo) plus the
                 # Watchtower audit summary — the automated-verdict
@@ -1867,6 +2002,14 @@ class DDSRestServer:
                     # the fleet-wide bottleneck-stage verdict
                     self._sample_state_gauges()
                     return Response.json(self._fleet.fleet_profile())
+                if arg == "canary":
+                    # Heliograph rollup: every host's dds_canary_* gauges
+                    # (carried by the shipped metrics_text) merged into
+                    # per-host verdicts + the fleet-wide worst-of view,
+                    # with failure exemplar trace ids resolvable against
+                    # GET /fleet/incidents?trace_id=...
+                    self._sample_state_gauges()
+                    return Response.json(self._fleet.fleet_canary())
                 return Response(404)
 
             case ("GET", "profile") if self.cfg.profile_route_enabled:
@@ -2037,6 +2180,8 @@ class DDSRestServer:
             counts_t: dict[str, int] = {}
             for k in self.stored_keys:
                 t = self._key_tenant(k)
+                if t == CANARY_TENANT:
+                    continue  # synthetic keyspace, not a tenant footprint
                 counts_t[t] = counts_t.get(t, 0) + 1
             for t, n in counts_t.items():
                 metrics.set(
@@ -2096,6 +2241,18 @@ class DDSRestServer:
             queue="fold-coalescer",
             help="entries waiting in a bounded pipeline queue",
         )
+        # registry self-observation: label sets folded into `overflow`
+        # across all families — attribution decays silently once this
+        # moves, so dashboards must be able to alarm on it directly
+        metrics.set(
+            "dds_metrics_dropped_series", metrics.overflow_total(),
+            help="total label sets dropped into overflow series by the "
+                 "per-family cardinality cap",
+        )
+        # Heliograph canary gauges: last verdict / last-ok age per probe
+        # kind, rotating failure exemplars, region unreachable streaks
+        if self.heliograph is not None:
+            self.heliograph.export_gauges(metrics)
         # SLO burn/budget gauges + audit backlog (scrape-time freshness is
         # all a gauge promises; the violation COUNTER increments at
         # detection time in the auditor itself)

@@ -20,6 +20,13 @@ except ModuleNotFoundError as _e:  # pragma: no cover - env-dependent
     _CRYPTO_ERR = _e
 
 
+def aes_available() -> bool:
+    """True when the `cryptography` package backs the AES schemes. Callers
+    that can degrade (Heliograph's canary domain encrypts only synthetic
+    plaintexts) check this instead of trapping the first-use error."""
+    return Cipher is not None
+
+
 def aes_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
     """AES-256-CTR keystream application (encrypt == decrypt)."""
     if Cipher is None:

@@ -88,6 +88,12 @@ class HomoProvider:
                 return str(k.mse.public.encrypt(int(value)))
             case "None":
                 return k.none.encrypt(str(value))
+            case "Plain":
+                # null cipher: deterministic passthrough for AES-less
+                # degraded domains (Heliograph's canary schema when the
+                # cryptography package is absent) — synthetic plaintexts
+                # only, never a substitute for a real scheme on user data
+                return str(value)
         raise ValueError(f"unknown scheme tag {tag!r}")
 
     def decrypt(self, value, tag: str):
@@ -105,6 +111,8 @@ class HomoProvider:
                 return k.mse.decrypt(int(value))
             case "None":
                 return k.none.decrypt(str(value))
+            case "Plain":
+                return str(value)
         raise ValueError(f"unknown scheme tag {tag!r}")
 
     def encrypt_row(self, row: list, until: int, schema: list[str]) -> list:

@@ -180,6 +180,29 @@ class Registry:
                 return None
             return {"count": s[2], "sum": s[1]}
 
+    def overflow_total(self) -> float:
+        """Total label sets folded into `overflow` series across every
+        family — the registry's dropped-series count. Exported at scrape
+        time as the `dds_metrics_dropped_series` gauge so dashboards can
+        alarm on cardinality overflow without parsing the per-family
+        counter."""
+        with self._lock:
+            fam = self._families.get(OVERFLOW_COUNTER)
+            if fam is None:
+                return 0.0
+            return float(sum(fam.samples.values()))
+
+    def clear_family(self, name: str) -> None:
+        """Drop every series of one family (help/kind registration stays).
+        For scrape-time re-exported info gauges whose LABEL VALUES rotate
+        (Heliograph's exemplar trace ids): the exporter clears and re-sets
+        the current series each sample, so rotation can never accrete
+        stale series toward the cardinality cap."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                fam.samples.clear()
+
     def reset(self) -> None:
         with self._lock:
             self._families.clear()

@@ -101,7 +101,7 @@ def process_info(registry=None, *, role: str, shard: str = "",
     from dds_tpu import __version__
 
     reg = registry if registry is not None else default_metrics
-    reg.set(
+    reg.set(  # argus: ok[metrics.unbounded-label] one series per process lifetime; start_ts is boot identity, not request-scoped
         "dds_process_info", 1.0,
         role=role, shard=shard or "-", region=region or "-",
         pid=str(os.getpid()),
@@ -716,6 +716,24 @@ class FleetCollector:
                 out[gid] = age
         return out
 
+    def source_regions(self) -> dict[str, str]:
+        """Shard gid -> home region, from the shipped identity labels.
+        Feeds Helmsman's `regions` signal on the Meridian proxy role so
+        canary region evidence (Heliograph) and region_down declarations
+        can map back to the groups homed there. Freshest source wins a
+        contested gid, mirroring `source_ages`."""
+        now = time.monotonic()
+        best: dict[str, tuple[float, str]] = {}
+        for src in self._sources.values():
+            gid = src.get("shard") or ""
+            region = src.get("region", "") or ""
+            if not gid or not region:
+                continue
+            age = now - src["mono"]
+            if gid not in best or age < best[gid][0]:
+                best[gid] = (age, region)
+        return {gid: region for gid, (_, region) in best.items()}
+
     def fleet_metrics(self) -> str:
         """The `GET /fleet/metrics` body: every source's exposition merged
         into one valid document, samples labeled by origin, plus
@@ -883,6 +901,70 @@ class FleetCollector:
                     top = {"route": route, "stage": best[0],
                            "p95_ms": round(best[1], 3), "host": best[2]}
         return {"hosts": hosts, "fleet": {"routes": routes, "top": top}}
+
+    _CANARY_VERDICTS = ("ok", "slow", "wrong_answer", "unreachable")
+
+    def fleet_canary(self) -> dict:
+        """The `GET /fleet/canary` body: every host's Heliograph ledger
+        state (carried as `dds_canary_*` gauges inside the shipped
+        metrics_text — zero wire-format changes, like the pipe profile)
+        rolled up per probe kind.
+
+        Rollup semantics: a kind's fleet verdict is the WORST across
+        hosts (the verdict enum is severity-ordered) — one region's
+        prober seeing wrong answers IS the fleet's problem, not a
+        minority report to average away. `failures` lists every host's
+        current exemplar, newest-first by ledger sequence; each trace id
+        resolves via `GET /fleet/incidents?trace_id=...` into the
+        stitched Chronoscope span tree for that probe."""
+        hosts: dict = {}
+        kinds: dict = {}
+        failures: list = []
+        regions_down: set[str] = set()
+        enum = self._CANARY_VERDICTS
+        for r in self._source_rows():
+            hrow = hosts.setdefault(r["host"], {
+                "role": r["role"], "shard": r["shard"],
+                "region": r.get("region", ""),
+                "age_s": round(r["age_s"], 3), "stale": r["stale"],
+                "kinds": {},
+            })
+            text = r["metrics_text"]
+            for labels, v in parse_samples(text, "dds_canary_verdict"):
+                kind = labels.get("kind", "-")
+                i = int(v) if 0 <= v < len(enum) else len(enum) - 1
+                hrow["kinds"].setdefault(kind, {})["verdict"] = enum[i]
+                agg = kinds.setdefault(kind, {"worst": 0, "hosts": 0})
+                agg["hosts"] += 1
+                agg["worst"] = max(agg["worst"], i)
+            for labels, v in parse_samples(
+                    text, "dds_canary_last_ok_age_seconds"):
+                kind = labels.get("kind", "-")
+                hrow["kinds"].setdefault(kind, {})["last_ok_age_s"] = (
+                    round(v, 3))
+            for labels, v in parse_samples(text, "dds_canary_exemplar"):
+                failures.append({
+                    "host": r["host"], "region": r.get("region", ""),
+                    "kind": labels.get("kind", "-"),
+                    "verdict": labels.get("verdict", "-"),
+                    "trace_id": labels.get("trace_id", ""),
+                    "seq": v,
+                })
+            for labels, v in parse_samples(
+                    text, "dds_canary_region_unreachable"):
+                if v and labels.get("region"):
+                    regions_down.add(labels["region"])
+        failures.sort(key=lambda f: -f["seq"])
+        for agg in kinds.values():
+            agg["worst"] = enum[agg["worst"]]
+        return {
+            "hosts": hosts,
+            "fleet": {
+                "kinds": kinds,
+                "failures": failures[:32],
+                "unreachable_regions": sorted(regions_down),
+            },
+        }
 
     def fleet_incidents(self, trace_id: str | None = None) -> dict:
         """The `GET /fleet/incidents` body: shipped incident-index entries

@@ -540,6 +540,17 @@ class Watchtower:
 
     # -------------------------------------------------------------- verdicts
 
+    def report_violation(self, invariant: str, trace_id, **detail) -> "Verdict":
+        """External evidence entry point: a plane that PROVED a violation
+        by independent means files the verdict here so it lands in the
+        same ledger / metrics / flight-incident surface as the passive
+        audits. Heliograph's decrypt-and-verify probes use this for
+        `canary_wrong_answer` — exactly the forged-tag/corruption class
+        the BFT audits exist for, caught by an active check the passive
+        tag algebra cannot see (a well-MAC'd wrong ciphertext is
+        quorum-consistent)."""
+        return self._violate(invariant, trace_id, **detail)
+
     def _violate(self, invariant: str, trace_id, **detail) -> Verdict:
         v = Verdict(invariant, trace_id, time.time(), detail)
         with self._lock:

@@ -446,6 +446,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             tenancy=cfg.tenancy,
             resident=cfg.resident,
             search=cfg.search,
+            heliograph=cfg.heliograph,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
         ),
@@ -615,6 +616,7 @@ def proxy_config(cfg: DDSConfig, supervisor, ssl_server, ssl_client,
         tenancy=cfg.tenancy,
         resident=cfg.resident,
         search=cfg.search,
+        heliograph=cfg.heliograph,
         # operator reshape control (POST /_reshard, /_helmsman) — gated
         # exactly like the Meridian proxy role; without a reshard
         # controller wired the routes still 404
@@ -759,6 +761,13 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
             regions=(lambda c=const: {
                 g.gid: g.home_region for g in c.groups if g.home_region
             }) if cfg.geo.enabled else None,
+            # Heliograph: sustained canary unreachability from a region is
+            # black-box promotion evidence — the probes exercise the real
+            # serving path, so they fire even while heartbeats stay green
+            canary_unreachable=(lambda s=server: (
+                s.heliograph.unreachable_regions()
+                if s.heliograph is not None else set()
+            )) if cfg.heliograph.enabled else None,
         )
         if admission is not None:
             admission.subscribe(hm.on_admission)

@@ -695,6 +695,56 @@ class AttackConfig:
 
 
 @dataclass
+class HeliographConfig:
+    """Heliograph active canary plane (`[heliograph]`, dds_tpu/obs/
+    heliograph): a supervised async prober per proxy (and per Meridian
+    process) owning the reserved `__heliograph__` tenant, continuously
+    driving golden transactions through the real client crypto path —
+    PutSet -> quorum write -> GetSet read-your-write, SumAll/MultAll
+    decrypt-and-compare over a known plaintext population, one Spyglass
+    search, one Prism MatVec — and verifying every answer by decrypting
+    it. Outcomes (ok / slow / wrong-answer / unreachable) land in the
+    CanaryLedger (`GET /canary`, `/metrics`, fleet-federated as
+    `GET /fleet/canary`), synthetic per-route-class SLO streams, a
+    Watchtower incident on wrong-answer, and Helmsman's region-down
+    signal on sustained unreachable. DEPLOY.md "Active probing
+    (Heliograph)" is the runbook."""
+
+    enabled: bool = False
+    # seconds between probe cycles (each cycle runs every probe kind once)
+    cadence: float = 5.0
+    # fraction of cadence randomized per sleep (0.5 = +/-50%): jittered
+    # scheduling so a fleet of probers never phase-locks into a thundering
+    # herd against one proxy
+    jitter: float = 0.5
+    # per-probe wall deadline (seconds); a probe past it is `unreachable`
+    deadline: float = 2.0
+    # latency above which an otherwise-correct probe is verdicted `slow`
+    slow_ms: float = 250.0
+    # known plaintext rows the canary keyspace holds (aggregate ground truth)
+    population: int = 4
+    # canary crypto domain key sizes — deliberately small: the prober
+    # measures the PIPE, not the modmul kernel, and generates at startup
+    paillier_bits: int = 512
+    rsa_bits: int = 512
+    # explicit rate bound on the canary admission carve-out: probe
+    # requests bypass tenant-fair admission but pass a dedicated token
+    # bucket, so a wedged/looping prober can never self-DoS the fleet
+    rate: float = 20.0
+    burst: float = 40.0
+    # probe kinds to run (subset of: putget sum mult search matvec)
+    probes: list[str] = field(
+        default_factory=lambda: ["putget", "sum", "mult", "search", "matvec"])
+    # extra proxy targets ("host:port" or "region=host:port") probed
+    # round-robin in addition to the local loopback edge — per-region /
+    # per-group targeting in fleets; [] probes only the local process
+    targets: list[str] = field(default_factory=list)
+    # consecutive unreachable probe cycles against one region before the
+    # ledger flags it to Helmsman's region_down/promotion signal
+    unreachable_streak: int = 3
+
+
+@dataclass
 class DDSConfig:
     replicas: ReplicaTopology = field(default_factory=ReplicaTopology)
     security: SecurityConfig = field(default_factory=SecurityConfig)
@@ -716,6 +766,7 @@ class DDSConfig:
     geo: GeoConfig = field(default_factory=GeoConfig)
     retry: RetryConfig = field(default_factory=RetryConfig)
     chaos: ChaosNetConfig = field(default_factory=ChaosNetConfig)
+    heliograph: HeliographConfig = field(default_factory=HeliographConfig)
     debug: bool = False
 
     # ------------------------------------------------------------- loading
@@ -775,6 +826,7 @@ _SUBSECTIONS = {
     ("DDSConfig", "geo"): GeoConfig,
     ("DDSConfig", "retry"): RetryConfig,
     ("DDSConfig", "chaos"): ChaosNetConfig,
+    ("DDSConfig", "heliograph"): HeliographConfig,
     ("ClientSettings", "data_table"): DataTableConfig,
     ("ObsConfig", "fleet"): FleetObsConfig,
 }

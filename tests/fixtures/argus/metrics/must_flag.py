@@ -1,0 +1,27 @@
+"""Must-flag corpus for the ``metrics`` pass: every rule fires.
+
+Never imported — linted as text by tests/test_argus.py. Each flagged
+line names its expected rule; the twin ``must_pass.py`` does the same
+work the sanctioned way.
+"""
+
+from dds_tpu.obs.metrics import metrics
+
+
+def registers_blank_help(n: int):
+    metrics.set("dds_fixture_depth", n, help="")      # metrics.empty-help
+
+
+def serve_request(tenant: str, key: str, trace_id: str, seconds: float):
+    metrics.inc("dds_fixture_requests_total",          # metrics.unbounded-label
+                tenant=tenant,
+                help="requests by tenant")
+    metrics.observe("dds_fixture_seconds", seconds,    # metrics.unbounded-label
+                    key=key,
+                    help="latency by key")
+    metrics.set("dds_fixture_last_seen", 1.0,          # metrics.unbounded-label
+                shard=f"group-{key}",
+                help="interpolated shard label")
+    metrics.inc("dds_fixture_failures_total",          # metrics.unbounded-label
+                trace_id=trace_id,
+                help="failures by exemplar trace")

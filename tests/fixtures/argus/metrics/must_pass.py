@@ -1,0 +1,31 @@
+"""Must-pass twin of the ``metrics`` corpus: the same series, bounded.
+
+Help text is written once at the registration touch, wire-supplied
+identifiers are bounded through an explicit capping call before they
+become label values, and enum-like labels use literals.
+"""
+
+from dds_tpu.obs.metrics import metrics
+
+_KNOWN_TENANTS = ("alpha", "beta")
+
+
+def _cap(value: str, known=_KNOWN_TENANTS) -> str:
+    return value if value in known else "other"
+
+
+def registers_documented(n: int):
+    metrics.set("dds_fixture_depth", n,
+                help="fixture queue depth (bounded: no labels)")
+
+
+def serve_request(tenant: str, seconds: float):
+    metrics.inc("dds_fixture_requests_total",
+                tenant=_cap(tenant),
+                help="requests by tenant (capped to the known set)")
+    metrics.observe("dds_fixture_seconds", seconds,
+                    route="putset",
+                    help="latency by route (literal label)")
+    metrics.set("dds_fixture_last_seen", 1.0,
+                shard="group-0",
+                help="literal shard label")

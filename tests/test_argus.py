@@ -43,6 +43,7 @@ EXPECTED_RULES = {
     "dispatch": {"jit-per-call", "host-roundtrip", "stray-sync"},
     "trust": {"unverified-store"},
     "secret": {"secret-flow"},
+    "metrics": {"empty-help", "unbounded-label"},
 }
 
 # the secret corpus must cover every sink class

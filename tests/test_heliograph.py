@@ -1,0 +1,605 @@
+"""Heliograph active-canary-plane tests (dds_tpu/obs/heliograph + clt/canary).
+
+Three layers:
+
+- deterministic unit surface on injected clock/rng/client: jittered
+  cadence bounds, verdict classification, the typed ledger (counts,
+  report, exemplar rotation under the cardinality discipline, the
+  consecutive-unreachable region streak), the /health section semantics
+  (disabled / ok / failing / stale, never blocking), and the feed
+  fan-out — a wrong-answer probe files a `canary_wrong_answer`
+  Watchtower incident carrying the exemplar trace id, sustained
+  unreachable feeds Helmsman's region_down/promotion signal;
+- the tenant boundary: `__heliograph__` passes the edge clamp (and ONLY
+  it — other dunder names still 400), canary rows are invisible to
+  user-facing aggregates/search in BOTH tenancy modes while the canary's
+  own exact-value checks see exactly its population;
+- the flagship drill on a real mini-stack: golden transactions all green
+  end to end, then `seed_ciphertext_corruption` flips a stored Paillier
+  ciphertext past the HMAC boundary — GetSet stays 200 (passive surfaces
+  green) while the next decrypt-and-verify sum probe lands wrong_answer
+  within one probe period, raising the Watchtower incident.
+"""
+
+import asyncio
+import contextlib
+import json
+import random
+import time
+
+import pytest
+
+from dds_tpu.clt.canary import (
+    PROBE_KINDS,
+    CanaryClient,
+    CanaryTarget,
+    ProbeCheck,
+    parse_canary_targets,
+)
+from dds_tpu.core.quorum_client import AbdClient, AbdClientConfig
+from dds_tpu.core.tenant import CANARY_TENANT, TenantError, validate_tenant
+from dds_tpu.core.transport import InMemoryNet
+from dds_tpu.fleet import Helmsman
+from dds_tpu.http.miniserver import http_request
+from dds_tpu.http.server import DDSRestServer, ProxyConfig
+from dds_tpu.models.facade import HomoProvider
+from dds_tpu.obs.heliograph import (
+    VERDICTS,
+    CanaryLedger,
+    Heliograph,
+    ProbeResult,
+    seed_ciphertext_corruption,
+)
+from dds_tpu.obs.metrics import Registry, metrics
+from dds_tpu.obs.slo import SloEngine
+from dds_tpu.obs.watchtower import Watchtower
+from dds_tpu.utils.config import HeliographConfig, TenancyConfig
+from tests.test_core import run
+
+pytestmark = pytest.mark.canary
+
+BITS = 256  # tiny Paillier primes: pipe semantics, not crypto strength
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class ScriptClient:
+    """Scriptable stand-in for CanaryClient: `script[kind]` is a
+    ProbeCheck to return or an exception to raise; `tick` optionally
+    advances an injected clock inside the probe (drives `slow`)."""
+
+    population = 2
+
+    def __init__(self, clock=None):
+        self.script: dict = {}
+        self.clock = clock
+        self.tick = 0.0
+        self._n = 0
+
+    def mint_trace(self) -> str:
+        self._n += 1
+        return f"trace-{self._n:04d}"
+
+    async def populate(self, target, trace_id=None):
+        return None
+
+    async def probe(self, kind, target, trace_id, cycle=0):
+        if self.clock is not None and self.tick:
+            self.clock.advance(self.tick)
+        action = self.script.get(kind, ProbeCheck(True, 200))
+        if isinstance(action, Exception):
+            raise action
+        return action
+
+
+class SloRecorder:
+    def __init__(self):
+        self.seen = []
+
+    def observe(self, route, status, dur_s, tenant=None):
+        self.seen.append((route, status))
+
+
+def _cfg(**kw) -> HeliographConfig:
+    kw.setdefault("enabled", True)
+    kw.setdefault("cadence", 5.0)
+    kw.setdefault("jitter", 0.5)
+    kw.setdefault("deadline", 2.0)
+    kw.setdefault("slow_ms", 250.0)
+    return HeliographConfig(**kw)
+
+
+def _helio(clock=None, client=None, seed=7, **kw):
+    clock = clock or FakeClock()
+    client = client if client is not None else ScriptClient(clock)
+    slo = kw.pop("slo", SloRecorder())
+    wt = kw.pop("watchtower", Watchtower())
+    h = Heliograph(
+        _cfg(**kw), [CanaryTarget("127.0.0.1", 1, region="east")],
+        slo=slo, watchtower=wt, clock=clock,
+        rng=random.Random(seed), client=client,
+    )
+    return h, clock, client, slo, wt
+
+
+# --------------------------------------------------- edge clamp + targets
+
+
+def test_canary_tenant_passes_the_edge_clamp_and_only_it():
+    assert validate_tenant(CANARY_TENANT) == CANARY_TENANT
+    for impostor in ("__heliograph", "_heliograph__", "__canary__", "__x__"):
+        with pytest.raises(TenantError):
+            validate_tenant(impostor)
+
+
+def test_parse_canary_targets_regions_and_malformed():
+    targets, bad = parse_canary_targets(
+        ["10.0.0.1:9000", "west=10.0.0.2:9001", "nope", "x:notaport"]
+    )
+    assert [(t.host, t.port, t.region) for t in targets] == [
+        ("10.0.0.1", 9000, ""), ("10.0.0.2", 9001, "west"),
+    ]
+    assert bad == ["nope", "x:notaport"]
+    assert targets[1].label == "10.0.0.2:9001"
+
+
+# ------------------------------------------------------ cadence + verdicts
+
+
+def test_next_delay_jitter_bounds_and_determinism():
+    h, *_ = _helio(cadence=5.0, jitter=0.5, seed=42)
+    delays = [h.next_delay() for _ in range(200)]
+    assert all(2.5 <= d <= 7.5 for d in delays)
+    assert len({round(d, 6) for d in delays}) > 50  # actually jittered
+    h2, *_ = _helio(cadence=5.0, jitter=0.5, seed=42)
+    assert [h2.next_delay() for _ in range(200)] == delays  # seeded = replay
+    h3, *_ = _helio(cadence=0.0, jitter=1.0)
+    assert h3.next_delay() >= 0.05  # floor: a zero cadence must not spin
+
+
+def test_classify_covers_the_verdict_lattice():
+    h, *_ = _helio(slow_ms=250.0)
+    assert h.classify(True, 200, 0.010) == "ok"
+    assert h.classify(True, 200, 0.500) == "slow"
+    assert h.classify(False, 200, 0.010) == "wrong_answer"
+    assert h.classify(False, 503, 0.010) == "unreachable"
+    assert h.classify(False, 0, 2.000) == "unreachable"  # no HTTP at all
+
+
+# ---------------------------------------------------------------- ledger
+
+
+def _result(kind="sum", verdict="ok", trace="t-1", region="", **kw):
+    return ProbeResult(kind, verdict, 0.01, trace, region=region, **kw)
+
+
+def test_ledger_report_counts_and_exemplars():
+    clk = FakeClock()
+    led = CanaryLedger(clock=clk, registry=Registry())
+    led.record(_result("sum", "ok", "t-1"))
+    clk.advance(5)
+    led.record(_result("sum", "wrong_answer", "t-2"))
+    clk.advance(5)
+    led.record(_result("putget", "ok", "t-3"))
+    rep = led.report()
+    assert rep["probes_recorded"] == 3
+    assert rep["counts"] == {"putget.ok": 1, "sum.ok": 1,
+                             "sum.wrong_answer": 1}
+    assert rep["kinds"]["sum"]["verdict"] == "wrong_answer"
+    assert rep["kinds"]["sum"]["last_failure"]["trace_id"] == "t-2"
+    assert rep["kinds"]["sum"]["last_ok_age_s"] == 10.0
+    assert led.last_age() == 0.0
+
+
+def test_ledger_exemplar_rotation_never_accretes_series():
+    led = CanaryLedger(registry=Registry())
+    reg = Registry()
+    led.record(_result("sum", "wrong_answer", "t-old"))
+    led.export_gauges(reg)
+    assert reg.value("dds_canary_exemplar", kind="sum", trace_id="t-old",
+                     verdict="wrong_answer") is not None
+    led.record(_result("sum", "wrong_answer", "t-new"))
+    led.export_gauges(reg)
+    # the rotated trace id replaced the old series instead of joining it
+    assert reg.value("dds_canary_exemplar", kind="sum", trace_id="t-old",
+                     verdict="wrong_answer") is None
+    assert reg.value("dds_canary_exemplar", kind="sum", trace_id="t-new",
+                     verdict="wrong_answer") is not None
+    assert reg.value("dds_canary_verdict", kind="sum") == float(
+        VERDICTS.index("wrong_answer"))
+
+
+def test_ledger_region_streak_resets_and_ignores_anonymous():
+    led = CanaryLedger(registry=Registry(), unreachable_streak=3)
+    for _ in range(2):
+        led.record(_result(verdict="unreachable", region="west"))
+    assert led.unreachable_regions() == set()      # streak not reached
+    led.record(_result(verdict="ok", region="west"))
+    for _ in range(2):
+        led.record(_result(verdict="unreachable", region="west"))
+    assert led.unreachable_regions() == set()      # success reset the count
+    led.record(_result(verdict="unreachable", region="west"))
+    assert led.unreachable_regions() == {"west"}
+    for _ in range(5):
+        led.record(_result(verdict="unreachable", region=""))
+    assert led.unreachable_regions() == {"west"}   # "" never feeds Helmsman
+
+
+def test_health_section_semantics():
+    clk = FakeClock()
+    led = CanaryLedger(clock=clk, registry=Registry())
+    assert led.health_section(False, 15.0) == {"status": "disabled"}
+    assert led.health_section(True, 15.0)["status"] == "stale"  # never probed
+    led.record(_result("sum", "ok"))
+    assert led.health_section(True, 15.0)["status"] == "ok"
+    led.record(_result("putget", "wrong_answer"))
+    sec = led.health_section(True, 15.0)
+    assert sec["status"] == "failing"
+    assert sec["kinds"]["putget"]["verdict"] == "wrong_answer"
+    clk.advance(60)
+    assert led.health_section(True, 15.0)["status"] == "stale"
+
+
+# ------------------------------------------------------------- the prober
+
+
+def test_probe_once_feeds_slo_and_watchtower_with_exemplar_trace():
+    async def go():
+        h, clock, client, slo, wt = _helio()
+        target = h.targets[0]
+        ok = await h.probe_once("sum", target)
+        assert ok.verdict == "ok"
+        client.script["sum"] = ProbeCheck(
+            False, 200, {"expected": 46, "observed": 47})
+        bad = await h.probe_once("sum", target)
+        assert bad.verdict == "wrong_answer"
+        # the SLO engine saw both, as the synthetic canary route-class
+        assert slo.seen == [("canary.sum", 200), ("canary.sum", 500)]
+        # the Watchtower incident carries the SAME exemplar trace id the
+        # ledger reports, and the decrypt-and-verify evidence
+        v, = [x for x in wt.verdicts() if x.invariant == "canary_wrong_answer"]
+        assert v.trace_id == bad.trace_id
+        assert v.detail["observed"] == "47"
+        assert h.ledger.report()["kinds"]["sum"]["trace_id"] == bad.trace_id
+
+    run(go())
+
+
+def test_probe_once_maps_failure_modes_to_verdicts():
+    async def go():
+        h, clock, client, slo, wt = _helio(deadline=0.05)
+        target = h.targets[0]
+        client.script["sum"] = ConnectionRefusedError("edge down")
+        assert (await h.probe_once("sum", target)).verdict == "unreachable"
+        client.script["sum"] = ValueError("garbled body")
+        assert (await h.probe_once("sum", target)).verdict == "wrong_answer"
+        client.script["mult"] = ProbeCheck(True, 200)
+        client.tick = 0.5  # latency past slow_ms, still correct
+        assert (await h.probe_once("mult", target)).verdict == "slow"
+
+    run(go())
+
+
+def test_run_cycle_populate_failure_is_an_unreachable_verdict():
+    async def go():
+        h, clock, client, *_ = _helio()
+
+        async def broken_populate(target, trace_id=None):
+            raise ConnectionRefusedError("no edge")
+
+        client.populate = broken_populate
+        await h.run_cycle(h.targets[0])
+        last = h.ledger.last("putget")
+        assert last.verdict == "unreachable"
+        assert last.detail["phase"] == "populate"
+
+    run(go())
+
+
+def test_unreachable_streak_feeds_helmsman_promotion():
+    async def go():
+        h, clock, client, *_ = _helio(unreachable_streak=3)
+        client.script["sum"] = ConnectionRefusedError("region dark")
+        for _ in range(3):
+            await h.probe_once("sum", h.targets[0])
+        assert h.unreachable_regions() == {"east"}
+
+        promoted = []
+
+        async def promote(gid):
+            promoted.append(gid)
+
+        hm = Helmsman(
+            load_census=lambda: {"g-east": 10, "g-west": 10},
+            promote=promote,
+            regions=lambda: {"g-east": "east", "g-west": "west"},
+            canary_unreachable=h.unreachable_regions,
+            clock=clock,
+        )
+        assert await hm.step() == "promote"
+        assert promoted == ["g-east"]          # only the dark region's group
+        assert "east" in hm._regions_down      # region_down declared
+        # recovery clears the signal and the declaration
+        client.script["sum"] = ProbeCheck(True, 200)
+        await h.probe_once("sum", h.targets[0])
+        assert h.unreachable_regions() == set()
+        clock.advance(1000)
+        assert await hm.step() is None
+        assert "east" not in hm._regions_down
+
+    run(go())
+
+
+# ------------------------------------------------------------ fleet rollup
+
+
+def test_fleet_canary_rolls_up_worst_verdict_and_exemplars():
+    from dds_tpu.obs.panopticon import FleetCollector
+    from tests.test_panopticon import LoopNet
+
+    led_a = CanaryLedger(registry=Registry())
+    led_a.record(_result("sum", "ok", "t-a"))
+    rega = Registry()
+    led_a.export_gauges(rega)
+    led_b = CanaryLedger(registry=Registry())
+    led_b.record(_result("sum", "wrong_answer", "t-b", region="west"))
+    regb = Registry()
+    led_b.export_gauges(regb)
+
+    net = LoopNet()
+    col = FleetCollector(net, secret=b"s", host="proxy-1",
+                         watchtower=Watchtower(), registry=Registry())
+    now = time.monotonic()
+    for host, reg, region in (("host-a", rega, "east"),
+                              ("host-b", regb, "west")):
+        col._sources[host] = {
+            "mono": now, "role": "group", "shard": f"g-{host[-1]}",
+            "region": region, "metrics_text": reg.render(), "slo": {},
+            "dropped": 0,
+        }
+    body = col.fleet_canary()
+    assert body["fleet"]["kinds"]["sum"]["worst"] == "wrong_answer"
+    assert body["fleet"]["kinds"]["sum"]["hosts"] == 2
+    f, = body["fleet"]["failures"]
+    assert (f["host"], f["trace_id"], f["verdict"]) == (
+        "host-b", "t-b", "wrong_answer")
+    assert body["hosts"]["host-a"]["kinds"]["sum"]["verdict"] == "ok"
+
+
+# ----------------------------------------------- the real-stack mini fleet
+
+
+@contextlib.asynccontextmanager
+async def canary_stack(tenancy=False, **proxy_kw):
+    from dds_tpu.core.replica import BFTABDNode, ReplicaConfig
+
+    net = InMemoryNet()
+    rcfg = ReplicaConfig(quorum_size=3)
+    addrs = [f"replica-{i}" for i in range(4)]
+    replicas = {a: BFTABDNode(a, addrs, "supervisor", net, rcfg)
+                for a in addrs}
+    abd = AbdClient("proxy-0", net, addrs,
+                    AbdClientConfig(request_timeout=2.0, quorum_size=3))
+    if tenancy:
+        proxy_kw.setdefault("tenancy", TenancyConfig(enabled=True))
+    server = DDSRestServer(
+        abd, ProxyConfig(host="127.0.0.1", port=0, **proxy_kw),
+        slo=SloEngine(),
+    )
+    await server.start()
+    try:
+        yield server, replicas
+    finally:
+        await server.stop()
+
+
+def _provider() -> HomoProvider:
+    # Paillier/RSA/OPE are pure Python; the AES-backed CHE columns are
+    # optional here because CanaryClient degrades them to the "None"
+    # scheme when the cryptography package is absent — so the golden
+    # path stays testable in AES-less environments
+    return HomoProvider.generate(BITS, 512)
+
+
+async def _req(server, method, target, body=None, tenant=None, trace=None):
+    headers = {}
+    if tenant:
+        headers["x-dds-tenant"] = tenant
+    if trace:
+        headers["x-dds-trace"] = trace
+    return await http_request(
+        "127.0.0.1", server.cfg.port, method, target, body,
+        headers=headers or None, timeout=10.0,
+    )
+
+
+def test_golden_transactions_all_green_and_canary_scoped():
+    async def go():
+        async with canary_stack() as (server, _):
+            provider = _provider()
+            client = CanaryClient(provider, population=2)
+            target = CanaryTarget("127.0.0.1", server.cfg.port)
+            await client.populate(target, client.mint_trace())
+            assert len(client.keys) == 2
+
+            # a user stores rows through the SAME edge, untenanted
+            user_rows = [[500, "user-0", 1000, 5, "a", "b", "c", "blob-0"],
+                         [501, "user-1", 2000, 7, "a", "b", "c", "blob-1"]]
+            for row in user_rows:
+                enc = provider.encrypt_row(row, 8, client.schema)
+                status, _body = await _req(
+                    server, "POST", "/PutSet",
+                    json.dumps({"contents": enc}).encode())
+                assert status == 200
+
+            # every probe kind verifies against the canary population
+            # ALONE — user rows in the same store must not leak in
+            for kind in PROBE_KINDS:
+                check = await client.probe(
+                    kind, target, client.mint_trace(), cycle=0)
+                assert check.correct, (kind, check.detail)
+
+            # and the user's aggregate excludes the canary population
+            nsqr = provider.keys.psse.public.nsquare
+            status, body = await _req(
+                server, "GET", f"/SumAll?position=2&nsqr={nsqr}")
+            assert status == 200
+            observed = provider.decrypt(
+                json.loads(body.decode())["result"], "PSSE")
+            assert observed == 3000  # user rows only, no canary 10+11
+
+            # user search for a canary CHE value sees nothing (same
+            # deterministic scheme the canary stored under, so the
+            # ciphertexts match byte-for-byte — only scoping hides them)
+            enc = provider.encrypt("canary-0", client.schema[1])
+            status, body = await _req(
+                server, "POST", "/SearchEq?position=1",
+                json.dumps({"value": enc}).encode())
+            assert status == 200
+            assert json.loads(body.decode())["keyset"] == []
+
+    run(go())
+
+
+def test_canary_invisible_under_tenancy_and_unattributed():
+    async def go():
+        async with canary_stack(tenancy=True) as (server, _):
+            provider = _provider()
+            client = CanaryClient(provider, population=2)
+            target = CanaryTarget("127.0.0.1", server.cfg.port)
+            await client.populate(target, client.mint_trace())
+
+            row = [7, "acme-row", 300, 3, "a", "b", "c", "acme-blob"]
+            enc = provider.encrypt_row(row, 8, client.schema)
+            status, _body = await _req(
+                server, "POST", "/PutSet",
+                json.dumps({"contents": enc}).encode(), tenant="acme")
+            assert status == 200
+
+            # the tenant's aggregate is exactly its own row
+            nsqr = provider.keys.psse.public.nsquare
+            status, body = await _req(
+                server, "GET", f"/SumAll?position=2&nsqr={nsqr}",
+                tenant="acme")
+            assert status == 200
+            assert provider.decrypt(
+                json.loads(body.decode())["result"], "PSSE") == 300
+            # ... and the canary's is exactly its population
+            check = await client.probe("sum", target, client.mint_trace())
+            assert check.correct, check.detail
+
+            # per-tenant analytics attribution never sees the canary
+            server._sample_state_gauges()
+            assert metrics.value("dds_tenant_stored_keys",
+                                 tenant="acme") == 1
+            assert metrics.value("dds_tenant_stored_keys",
+                                 tenant=CANARY_TENANT) is None
+            # ... nor does per-tenant SLO burn attribution
+            assert CANARY_TENANT not in server.slo.tenant_burns()
+            # the dropped-series registry gauge is exported first-class
+            assert metrics.value("dds_metrics_dropped_series") is not None
+
+    run(go())
+
+
+def test_health_carries_canary_section_and_stays_fast_when_stopped():
+    async def go():
+        async with canary_stack() as (server, _):
+            # no prober wired: the section degrades to disabled
+            status, body = await _req(server, "GET", "/health")
+            assert status == 200
+            assert json.loads(body.decode())["canary"] == {
+                "status": "disabled"}
+
+            # prober wired but STOPPED: /health must answer from memory,
+            # never await the prober, and stay fast
+            h, *_ = _helio()
+            server.heliograph = h
+            t0 = time.perf_counter()
+            status, body = await _req(server, "GET", "/health")
+            elapsed = time.perf_counter() - t0
+            assert status == 200
+            assert json.loads(body.decode())["canary"]["status"] == "disabled"
+            assert elapsed < 0.010, f"/health took {elapsed * 1e3:.1f}ms"
+
+            # GET /canary reports disabled without a prober elsewhere
+            server.heliograph = None
+            status, body = await _req(server, "GET", "/canary")
+            assert status == 200
+            assert json.loads(body.decode()) == {"enabled": False}
+
+    run(go())
+
+
+def test_canary_admission_carveout_is_rate_bounded():
+    async def go():
+        async with canary_stack() as (server, _):
+            # freeze refill: the bucket's remaining tokens are the whole
+            # budget, the bound a canary-tenant squatter can never exceed
+            server._canary_bucket.rate = 0.0
+            server._canary_bucket._tokens = 2.0
+            before = metrics.value("dds_canary_throttled_total",
+                                   route="GetSet") or 0
+            statuses = []
+            for _ in range(6):
+                status, body = await _req(server, "GET", "/GetSet/nokey",
+                                          tenant=CANARY_TENANT)
+                statuses.append(status)
+            assert statuses.count(429) == 4
+            assert (metrics.value("dds_canary_throttled_total",
+                                  route="GetSet") or 0) == before + 4
+            # exempt routes (health) stay reachable for the canary tenant
+            status, _body = await _req(server, "GET", "/health",
+                                       tenant=CANARY_TENANT)
+            assert status == 200
+
+    run(go())
+
+
+# --------------------------------------------------------------- the drill
+
+
+def test_seeded_corruption_detected_by_decrypt_and_verify():
+    async def go():
+        async with canary_stack() as (server, replicas):
+            provider = _provider()
+            client = CanaryClient(provider, population=2)
+            target = CanaryTarget("127.0.0.1", server.cfg.port)
+            wt = Watchtower()
+            h = Heliograph(_cfg(), [target], watchtower=wt, client=client)
+            await client.populate(target, client.mint_trace())
+
+            green = await h.probe_once("sum", target)
+            assert green.verdict == "ok"
+
+            # the seeded fault: flip one stored Paillier ciphertext on
+            # every replica, PAST the transport-HMAC boundary
+            assert seed_ciphertext_corruption(
+                replicas, client.keys[0], position=2) == len(replicas)
+
+            # passive surfaces stay green: the quorum read still serves
+            # 200 over the (valid-MAC, wrong) ciphertext
+            status, _body = await _req(
+                server, "GET", f"/GetSet/{client.keys[0]}")
+            assert status == 200
+
+            # ... but the very next decrypt-and-verify probe catches it
+            red = await h.probe_once("sum", target)
+            assert red.verdict == "wrong_answer"
+            assert int(red.detail["observed"]) != int(red.detail["expected"])
+            v, = [x for x in wt.verdicts()
+                  if x.invariant == "canary_wrong_answer"]
+            assert v.trace_id == red.trace_id
+            assert h.ledger.report()["kinds"]["sum"]["last_failure"][
+                "trace_id"] == red.trace_id
+
+    run(go())

@@ -12,6 +12,7 @@ it a must-flag/must-pass fixture twin under tests/fixtures/argus/.
 
 from tools.argus.passes.async_hazard import AsyncHazardPass
 from tools.argus.passes.dispatch import DispatchHygienePass
+from tools.argus.passes.metrics_hygiene import MetricsHygienePass
 from tools.argus.passes.secret_taint import SecretTaintPass
 from tools.argus.passes.trust_boundary import TrustBoundaryPass
 
@@ -20,6 +21,7 @@ PASSES = {
     "dispatch": DispatchHygienePass,
     "trust": TrustBoundaryPass,
     "secret": SecretTaintPass,
+    "metrics": MetricsHygienePass,
 }
 
 

@@ -1,0 +1,124 @@
+"""Argus pass ``metrics``: hygiene of the /metrics exposition surface.
+
+The registry (obs/metrics.py) is the fleet's shared dashboard language:
+`# HELP` text is what an operator paged at 3am reads first, and label
+cardinality is the difference between a scrape and an OOM. Heliograph's
+canary series raised the bar — rotating exemplar labels and per-kind
+enums must stay bounded by construction — so the discipline is now
+machine-checked. The rules:
+
+- ``empty-help`` — a metric call that passes ``help=""`` explicitly: the
+  series renders with no `# HELP` line while LOOKING documented at the
+  call site. Either write the one-line help or drop the kwarg (a later
+  documented touch backfills it — see Registry._family).
+- ``unbounded-label`` — a label value that interpolates request-scoped
+  identity into the series space: an f-string label value with any
+  formatted field, or a raw (non-literal, non-call) value bound to a
+  known-unbounded label name (``tenant``, ``key``, ``trace_id``,
+  ``kid``). Wire-supplied identifiers are a cardinality attack surface;
+  the per-family cap folds the overflow, but every folded series is a
+  blinded dashboard. Sanctioned forms pass: string/number literals, and
+  any call expression (a capper like ``_cap(tenant)`` or an enum like
+  ``VERDICTS.index(v)`` is a deliberate bounding step).
+
+Scope is deliberately narrow: only calls of ``inc``/``set``/``observe``
+on a registry-shaped receiver (``metrics``, ``reg``, ``registry``,
+``_reg``, ``_registry`` as the final attribute) whose first argument is
+a string literal metric name — so contextvar ``.set(...)`` and
+``Event.set()`` never false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.argus.engine import Finding, dotted_name, iter_scopes, scope_calls
+
+# method names on a registry receiver that create/write series
+_METRIC_METHODS = {"inc", "set", "observe"}
+
+# final attribute of the receiver's dotted name that marks it a registry
+_REGISTRY_NAMES = {"metrics", "reg", "registry", "_reg", "_registry"}
+
+# kwargs that are parameters of the call, not labels
+_NON_LABEL_KWARGS = {"help", "n", "buckets"}
+
+# label names whose values are request-scoped identity unless bounded
+_UNBOUNDED_LABELS = {"tenant", "key", "trace_id", "kid"}
+
+
+def _is_metric_call(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in _METRIC_METHODS:
+        return False
+    recv = dotted_name(call.func.value)
+    if recv.rsplit(".", 1)[-1] not in _REGISTRY_NAMES:
+        return False
+    return bool(call.args) and isinstance(call.args[0], ast.Constant) \
+        and isinstance(call.args[0].value, str)
+
+
+def _interpolates(value: ast.expr) -> bool:
+    return isinstance(value, ast.JoinedStr) and any(
+        isinstance(part, ast.FormattedValue) for part in value.values
+    )
+
+
+class MetricsHygienePass:
+    pass_id = "metrics"
+
+    def applies(self, rel_path: str) -> bool:
+        return rel_path.endswith(".py")
+
+    def run(self, tree: ast.Module, src: str, rel_path: str) -> list[Finding]:
+        out: list[Finding] = []
+        for scope in iter_scopes(tree):
+            for call in scope_calls(scope.body):
+                if not _is_metric_call(call):
+                    continue
+                metric = call.args[0].value
+                out += self._check(call, metric, scope, rel_path)
+        return out
+
+    def _check(self, call: ast.Call, metric: str, scope,
+               rel_path: str) -> list[Finding]:
+        out = []
+        for kw in call.keywords:
+            if kw.arg is None:        # **labels: dynamic, another pass's war
+                continue
+            if kw.arg == "help":
+                if isinstance(kw.value, ast.Constant) \
+                        and kw.value.value == "":
+                    out.append(Finding(
+                        rel_path, call.lineno, self.pass_id, "empty-help",
+                        f"metric {metric!r} registered with empty help text "
+                        f"in {scope.name} — write the one-line # HELP or "
+                        f"drop the kwarg and let a documented touch "
+                        f"backfill it",
+                        symbol=metric, scope=scope.name,
+                    ))
+                continue
+            if kw.arg in _NON_LABEL_KWARGS:
+                continue
+            if _interpolates(kw.value):
+                out.append(Finding(
+                    rel_path, call.lineno, self.pass_id, "unbounded-label",
+                    f"label {kw.arg}= of metric {metric!r} interpolates an "
+                    f"f-string in {scope.name} — every distinct value mints "
+                    f"a series; bound the value or fold it into the metric "
+                    f"name",
+                    symbol=metric, scope=scope.name,
+                ))
+            elif kw.arg in _UNBOUNDED_LABELS and not isinstance(
+                    kw.value, (ast.Constant, ast.Call)):
+                out.append(Finding(
+                    rel_path, call.lineno, self.pass_id, "unbounded-label",
+                    f"label {kw.arg}= of metric {metric!r} carries a raw "
+                    f"request-scoped identifier in {scope.name} — a "
+                    f"wire-supplied {kw.arg} is a cardinality attack "
+                    f"surface; cap it (e.g. a bounded mapping) or baseline "
+                    f"with the defense written down",
+                    symbol=metric, scope=scope.name,
+                ))
+        return out
