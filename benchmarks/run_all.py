@@ -25,7 +25,7 @@ def main(argv=None):
                             multihost_load, overload_goodput, pipe_profile,
                             product, put_concurrency, resident_fold,
                             search_latency, shard_scaling, sweep,
-                            tenant_isolation)
+                            tenant_isolation, tiered_fold)
 
     rows = []
     if args.quick:
@@ -61,6 +61,10 @@ def main(argv=None):
             ["--k", "64", "--shards", "1,2", "--bits", "256",
              "--repeats", "2"]
         )
+        rows += tiered_fold.main(
+            ["--max-rows", "32", "--pop-factor", "10", "--hot", "16",
+             "--bits", "256", "--repeats", "2"]
+        )
         rows += decrypt_throughput.main(
             ["--bits", "512", "--b", "48", "--repeats", "1"]
         )
@@ -90,6 +94,7 @@ def main(argv=None):
         rows += fleet_obs_overhead.main([])
         rows += pipe_profile.main([])
         rows += resident_fold.main([])
+        rows += tiered_fold.main([])
         rows += decrypt_throughput.main([])
         rows += search_latency.main([])
         rows += autoscale_goodput.main([])

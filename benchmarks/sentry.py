@@ -11,6 +11,7 @@ Modes (all emit one JSON line to stdout):
         `overload goodput` (benchmarks/overload_goodput.py),
         `multihost load` (benchmarks/multihost_load.py),
         `resident fold` (benchmarks/resident_fold.py),
+        `tiered fold` (benchmarks/tiered_fold.py),
         `fleet obs` (benchmarks/fleet_obs_overhead.py),
         `pipe profile` (benchmarks/pipe_profile.py),
         `decrypt throughput` (benchmarks/decrypt_throughput.py),
@@ -247,6 +248,41 @@ def _check_search_records(root: str = REPO) -> dict:
         if not ok:
             raise ValueError(
                 f"malformed search-latency record in {name}: "
+                f"{row.get('metric')!r}"
+            )
+        found += 1
+    return {"rows": found}
+
+
+def _check_tiered_records(root: str = REPO) -> dict:
+    """Validate `tiered fold` rows (benchmarks/tiered_fold.py): positive
+    folds/s value and a detail block naming the pool capacity, a
+    population that genuinely exceeds it, a FROZEN reset counter (the
+    whole point of eviction-to-warm), and positive ceiling/tiered
+    timings (the vs-no-tiering comparison the record exists for). Same
+    malformed contract as the other row families: exit 2."""
+    found = 0
+    for name, row in _iter_result_rows(root):
+        if not (isinstance(row, dict)
+                and str(row.get("metric", "")).startswith("tiered fold")):
+            continue
+        detail = row.get("detail")
+        ok = (
+            isinstance(row.get("value"), (int, float)) and row["value"] > 0
+            and isinstance(detail, dict)
+            and isinstance(detail.get("max_rows"), int)
+            and detail["max_rows"] >= 1
+            and isinstance(detail.get("population"), int)
+            and detail["population"] > detail["max_rows"]
+            and detail.get("resets") == 0
+            and isinstance(detail.get("ceiling_ms"), (int, float))
+            and detail["ceiling_ms"] > 0
+            and isinstance(detail.get("tiered_ms"), (int, float))
+            and detail["tiered_ms"] > 0
+        )
+        if not ok:
+            raise ValueError(
+                f"malformed tiered-fold record in {name}: "
                 f"{row.get('metric')!r}"
             )
         found += 1
@@ -649,6 +685,7 @@ def main(argv=None) -> int:
             fleet_obs = _check_fleet_obs_records()
             pipe = _check_pipe_records()
             resident = _check_resident_records()
+            tiered = _check_tiered_records()
             decrypt = _check_decrypt_records()
             search = _check_search_records()
             autoscale = _check_autoscale_records()
@@ -669,6 +706,7 @@ def main(argv=None) -> int:
             "fleet_obs_rows": fleet_obs["rows"],
             "pipe_rows": pipe["rows"],
             "resident_rows": resident["rows"],
+            "tiered_rows": tiered["rows"],
             "decrypt_rows": decrypt["rows"],
             "search_rows": search["rows"],
             "autoscale_rows": autoscale["rows"],

@@ -1,0 +1,161 @@
+"""Stratum tiered-fold benchmark: Zipf working sets past HBM capacity.
+
+The structural claim of the Stratum tier (dds_tpu/storage): a shard
+group can hold a ciphertext population ~10x its pool's `max_rows` —
+the overflow living in the host-pinned warm cache and the HMAC'd
+segment log — while folds over the *hot* subset stay within a small
+factor of the no-tiering ceiling, because the Zipf head is resident and
+only the tail streams. The pre-Stratum pool would RESET at the first
+over-capacity aggregate and every subsequent fold would re-ingest from
+scratch.
+
+Per configuration this sweep measures, over one Zipf(θ)-ranked
+population `pop_factor` times the pool's `max_rows`:
+
+- ceiling — an all-resident twin plane (max_rows >= population): ingest
+  + compile warmup, then the fused fold over the hot subset. The best
+  any tiering scheme can do;
+- tiered  — `Stratum.fold_groups` over the same operands with the small
+  pool: the population is driven through the tiers first (pool
+  admission -> eviction-to-warm -> segment overflow), then the hot
+  subset folds after promotion warmup.
+
+Every timed fold is equality-gated against the host-int reference fold
+first — a tier split that loses bit-for-bit exactness is a benchmark
+failure, not a data point. One `tiered fold` record per configuration
+lands in results.json via benchmarks/common.emit() (value = tiered
+folds/s over the hot subset, vs_baseline = ceiling_ms / tiered_ms — 1.0
+means the tier split is free, the acceptance bar is >= 0.9 on the warm
+hot set). benchmarks/sentry.py --check validates the records.
+
+Usage: python -m benchmarks.tiered_fold [--max-rows 64] [--pop-factor 10]
+       [--hot 32] [--theta 0.9] [--bits 512] [--repeats 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import tempfile
+import time
+
+from benchmarks.common import emit
+
+
+def _pyfold(cs, n):
+    acc = 1
+    for c in cs:
+        acc = acc * c % n
+    return acc
+
+
+def _zipf_hot_subset(rng, population, hot, theta, k):
+    """`k` draws from a Zipf(theta) rank distribution truncated to the
+    `hot` head of `population` — the clt/distribution.py access model,
+    inlined so the benchmark has no load-plane dependency."""
+    weights = [1.0 / ((i + 1) ** theta) for i in range(hot)]
+    total = sum(weights)
+    draws = []
+    for _ in range(k):
+        r = rng.random() * total
+        acc = 0.0
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= r:
+                draws.append(population[i])
+                break
+        else:  # pragma: no cover - float tail
+            draws.append(population[hot - 1])
+    return draws
+
+
+def _drive(max_rows: int, pop_factor: int, hot: int, theta: float,
+           bits: int, repeats: int, seed: int) -> dict:
+    from dds_tpu.resident import ResidentPlane
+    from dds_tpu.storage import Stratum
+
+    rng = random.Random(seed)
+    modulus = rng.getrandbits(bits) | (1 << (bits - 1)) | 1
+    population = [rng.randrange(2, modulus)
+                  for _ in range(max_rows * pop_factor)]
+    hot = min(hot, max_rows, len(population))
+    ops = _zipf_hot_subset(rng, population, hot, theta, k=max(hot, 32))
+    expect = _pyfold(ops, modulus)
+
+    # ceiling: the all-resident twin (HBM big enough for everything)
+    twin = ResidentPlane(
+        initial_rows=max_rows,
+        max_rows=max(len(population) * 2, 1 << 16),
+    )
+    assert twin.fold_groups([("g0", population)], modulus) \
+        == _pyfold(population, modulus), "twin diverged from host fold"
+    assert twin.fold_groups([("g0", ops)], modulus) == expect
+
+    plane = ResidentPlane(initial_rows=min(8, max_rows), max_rows=max_rows)
+    with tempfile.TemporaryDirectory() as tier_dir:
+        stratum = Stratum(plane, tier_dir,
+                          warm_bytes=max_rows * pop_factor * 16,
+                          chunk_rows=max(16, max_rows // 2))
+        # drive the whole population through the tiers (admission +
+        # eviction-to-warm + warm->segment overflow), equality-gated
+        assert stratum.fold_groups([("g0", population)], modulus) \
+            == _pyfold(population, modulus), "tier split diverged"
+        pool = plane.pool("g0", modulus)
+        assert pool.resets == 0, "tiered ingest must never reset the pool"
+        # promotion warmup: fold the hot subset until its rows are hot
+        for _ in range(3):
+            assert stratum.fold_groups([("g0", ops)], modulus) == expect
+
+        ceiling_ms = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            r = twin.fold_groups([("g0", ops)], modulus)
+            ceiling_ms.append((time.perf_counter() - t0) * 1e3)
+            assert r == expect
+
+        tiered_ms = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            r = stratum.fold_groups([("g0", ops)], modulus)
+            tiered_ms.append((time.perf_counter() - t0) * 1e3)
+            assert r == expect
+
+        tiers = stratum.stats()["tiers"]
+        return {
+            "max_rows": max_rows,
+            "population": len(population),
+            "hot": hot,
+            "resets": pool.resets,
+            "cold_rows": tiers["cold"]["rows"],
+            "warm_rows": tiers["warm"]["rows"],
+            "ceiling_ms": min(ceiling_ms),
+            "tiered_ms": min(tiered_ms),
+        }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--max-rows", type=int, default=64,
+                    help="pool capacity (the HBM tier) per group")
+    ap.add_argument("--pop-factor", type=int, default=10,
+                    help="population = max_rows * pop_factor")
+    ap.add_argument("--hot", type=int, default=32,
+                    help="Zipf head size the timed folds draw from")
+    ap.add_argument("--theta", type=float, default=0.9)
+    ap.add_argument("--bits", type=int, default=512)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=17)
+    args = ap.parse_args(argv)
+
+    d = _drive(args.max_rows, args.pop_factor, args.hot, args.theta,
+               args.bits, args.repeats, args.seed)
+    return [emit(
+        f"tiered fold (pop={d['population']}, hbm={d['max_rows']})",
+        1e3 / d["tiered_ms"], "folds/s",
+        d["ceiling_ms"] / d["tiered_ms"],  # 1.0 = tier split is free
+        **d,
+    )]
+
+
+if __name__ == "__main__":
+    main()

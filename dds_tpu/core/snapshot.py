@@ -59,17 +59,61 @@ _GEN_RE = re.compile(r"\.snapshot\.(\d{8})\.json$")
 
 
 def derive_secret(base: bytes = DEFAULT_BASE,
-                  node_key_path: str | os.PathLike | None = None) -> bytes:
+                  node_key_path: str | os.PathLike | None = None,
+                  label: bytes = b"dds-snapshot-mac-v2") -> bytes:
     """Snapshot MAC key: HMAC-derived from the intranet secret, mixed with
     the node's transport key file (utils/nodeauth) when one is provisioned
     — per-node keys then yield per-node snapshot keys, so one host's
-    snapshot cannot be replanted onto another."""
+    snapshot cannot be replanted onto another. `label` domain-separates
+    sibling on-disk formats sharing the discipline (Stratum's segment
+    store derives with its own label, so a snapshot footer can never
+    verify as a segment footer or vice versa)."""
     material = bytes(base)
     if node_key_path:
         p = pathlib.Path(node_key_path)
         if p.exists():
             material += p.read_bytes()
-    return hmac.new(material, b"dds-snapshot-mac-v2", hashlib.sha256).digest()
+    return hmac.new(material, label, hashlib.sha256).digest()
+
+
+def write_authenticated(path: pathlib.Path, body: bytes, secret: bytes) -> None:
+    """Write `body` + HMAC-SHA256 hex footer crash-safely: tmp file,
+    flush + fsync, atomic rename, then directory-fd fsync so the rename
+    itself is durable — the v2 snapshot discipline, shared with the
+    Stratum segment store (`storage/segment.py`). A crash at any point
+    leaves either the previous file or the complete new one."""
+    footer = hmac.new(secret, body, hashlib.sha256).hexdigest().encode()
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(body + b"\n" + footer + b"\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        # the rename itself must be durable, or a crash can resurface the
+        # old directory entry with the new data gone
+        dfd = os.open(path.parent, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover - fs-dependent
+        pass
+
+
+def read_authenticated(path: pathlib.Path, secret: bytes) -> bytes:
+    """Verify + strip the HMAC footer; returns the body bytes. Raises
+    ValueError on truncation or footer mismatch (corrupt or forged)."""
+    raw = path.read_bytes()
+    body, sep, footer = raw.rstrip(b"\n").rpartition(b"\n")
+    if not sep or not body:
+        raise ValueError("truncated (no footer)")
+    if not hmac.compare_digest(
+        hmac.new(secret, body, hashlib.sha256).hexdigest().encode(),
+        footer.strip(),
+    ):
+        raise ValueError("HMAC footer mismatch (corrupt or forged)")
+    return body
 
 
 def _generations(directory: pathlib.Path, name: str) -> list[tuple[int, pathlib.Path]]:
@@ -121,24 +165,8 @@ def save_replica(node: BFTABDNode, directory: str | os.PathLike,
         "nonces": {str(n): bool(e) for n, e in node.incoming.items()},
     }
     body = json.dumps(state, sort_keys=True, separators=(",", ":")).encode()
-    footer = hmac.new(secret, body, hashlib.sha256).hexdigest().encode()
     path = d / f"{node.name}.snapshot.{gen:08d}.json"
-    tmp = d / (path.name + ".tmp")
-    with open(tmp, "wb") as f:
-        f.write(body + b"\n" + footer + b"\n")
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    try:
-        # the rename itself must be durable, or a crash can resurface the
-        # old directory entry with the new data gone
-        dfd = os.open(d, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:  # pragma: no cover - fs-dependent
-        pass
+    write_authenticated(path, body, secret)
     for _, old in _generations(d, node.name)[max(1, keep):]:
         try:
             old.unlink()
@@ -151,16 +179,7 @@ def save_replica(node: BFTABDNode, directory: str | os.PathLike,
 
 
 def _read_v2(path: pathlib.Path, secret: bytes) -> dict:
-    raw = path.read_bytes()
-    body, sep, footer = raw.rstrip(b"\n").rpartition(b"\n")
-    if not sep or not body:
-        raise ValueError("truncated (no footer)")
-    if not hmac.compare_digest(
-        hmac.new(secret, body, hashlib.sha256).hexdigest().encode(),
-        footer.strip(),
-    ):
-        raise ValueError("HMAC footer mismatch (corrupt or forged)")
-    state = json.loads(body)
+    state = json.loads(read_authenticated(path, secret))
     if state.get("v") != 2:
         raise ValueError(f"unsupported snapshot version {state.get('v')!r}")
     return state

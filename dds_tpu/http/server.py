@@ -245,6 +245,13 @@ class ProxyConfig:
     # round + one predicate kernel dispatch per query instead of the
     # legacy full-keyspace scan. None/disabled = the legacy scan exactly.
     search: object = None
+    # Stratum tiered ciphertext storage (dds_tpu/storage): a
+    # StorageConfig-shaped object with enabled=True layers a host-pinned
+    # warm cache and an HMAC'd log-structured segment store under the
+    # Lodestone pools, replacing capacity resets with eviction-to-warm
+    # and splitting folds into resident + streamed-from-tier legs.
+    # None/disabled = Lodestone-only behavior exactly.
+    storage: object = None
     # active-replica refresh from supervisor (DDSRestServer.scala:139-147)
     replica_refresh_interval: float = 5.0
     supervisor: Optional[str] = None
@@ -404,6 +411,35 @@ class DDSRestServer:
             group_ids = getattr(self.abd, "group_ids", None)
             if group_ids is not None:
                 self._search.register_groups(group_ids())
+        # Stratum (dds_tpu/storage): the tier planner under Lodestone.
+        # Built only when a resident plane exists — the hot tier IS the
+        # pool; attach() rewires pool overflow from reset to eviction
+        # and routes folds through the hot+warm+cold split. None when
+        # disabled — every gate below is a cheap is-None check.
+        stcfg = self.cfg.storage
+        self._stratum = None
+        if (
+            stcfg is not None
+            and getattr(stcfg, "enabled", False)
+            and self._resident is not None
+        ):
+            from dds_tpu.storage import Stratum
+
+            self._stratum = Stratum(
+                self._resident,
+                getattr(stcfg, "dir", "./stratum"),
+                warm_bytes=getattr(stcfg, "warm_bytes", 64 << 20),
+                chunk_rows=getattr(stcfg, "chunk_rows", 256),
+                promote_score=getattr(stcfg, "promote_score", 2.0),
+                max_promote=getattr(stcfg, "max_promote", 256),
+                half_life=getattr(stcfg, "half_life", 60.0),
+                keep=getattr(stcfg, "keep", 3),
+                compact_segments=getattr(stcfg, "compact_segments", 8),
+            )
+            if self._search is not None:
+                # Spyglass selections feed the tier directory: keys a
+                # query keeps finding hold their fold rows hot
+                self._search.touch_sink = self._stratum.touch_keys
         # Prism analytics engine (analytics/prism): same backend, same
         # public-parameter boundary; sharded proxies hand it the router's
         # owner resolver so weighted folds scatter-gather like SumAll,
@@ -925,7 +961,14 @@ class DDSRestServer:
         if not ciphers:
             return
         gid = self.abd.owner(key) if self._shards is not None else ""
-        if plane.note_write(gid, ciphers, tenant=self._plane_tenant()):
+        tenant = self._plane_tenant()
+        if self._stratum is not None:
+            # popularity signal only (pure dict math, loop-safe): the
+            # rewrite of a tiered row warms its directory score so the
+            # next fold promotes it instead of streaming it, and the
+            # key->cipher mapping lets later Spyglass hits do the same
+            self._stratum.note_write(gid, ciphers, tenant=tenant, key=key)
+        if plane.note_write(gid, ciphers, tenant=tenant):
             self._resident_ingest_soon()
 
     def _resident_ingest_soon(self) -> None:
@@ -942,6 +985,19 @@ class DDSRestServer:
 
         self._ingest_task = supervised_task(_drain(),
                                             name="proxy.resident_ingest")
+
+    def tier_pressure(self) -> float:
+        """Blended hot+warm occupancy in [0, 1] for Helmsman's
+        pool-pressure signal: how close the fullest pool is to its
+        max_rows, or the warm cache to its byte budget, whichever is
+        tighter. 0.0 when Stratum is disabled — the autoscaler then
+        steers on burn/queue alone, exactly as before."""
+        if self._stratum is None:
+            return 0.0
+        try:
+            return float(self._stratum.pressure())
+        except Exception:
+            return 0.0
 
     # ----------------------------------------- Spyglass encrypted search
 
@@ -1085,7 +1141,9 @@ class DDSRestServer:
                 )
             )
         selected = set().union(*sets)
-        return [k for k in keys if k in selected]
+        hits = [k for k in keys if k in selected]
+        self._search.note_selected(hits, pt)
+        return hits
 
     async def _spy_order(self, pos: int, descending: bool) -> list[str]:
         """One indexed order-by query: per-group device-sorted runs
@@ -1112,7 +1170,9 @@ class DDSRestServer:
                 )
             )
         stored = set(keys)
-        return [k for _, k in heapq.merge(*runs) if k in stored]
+        ordered = [k for _, k in heapq.merge(*runs) if k in stored]
+        self._search.note_selected(ordered, pt)
+        return ordered
 
     @staticmethod
     def _page_params(req: Request) -> tuple[int, int | None]:
@@ -1870,6 +1930,11 @@ class DDSRestServer:
                     # Lodestone surface: per-pool residency, HBM bytes,
                     # reset churn, and the pending write-ingest queue
                     health["resident"] = self._resident.stats()
+                if self._stratum is not None:
+                    # Stratum surface: per-tier rows/bytes, directory
+                    # residency counts, hit/eviction/cold-read tallies,
+                    # and the blended occupancy pressure
+                    health["storage"] = self._stratum.stats()
                 if self._search is not None:
                     # Spyglass surface: per-group indexed keys/packs and
                     # the pending ingest queue
@@ -2226,6 +2291,10 @@ class DDSRestServer:
             # Lodestone gauges: dds_resident_{rows,bytes,hit_ratio,
             # resets}{shard=...}, aggregated per group at scrape time
             self._resident.export_gauges(metrics)
+        if self._stratum is not None:
+            # Stratum gauges: dds_tier_{rows,bytes}{tier,shard} — tier
+            # occupancy per shard group at scrape time
+            self._stratum.export_gauges(metrics)
         if self._search is not None:
             # Spyglass gauges: dds_search_{index_keys,index_packs,
             # pending_ingest,...}, per group at scrape time
@@ -2382,12 +2451,19 @@ class DDSRestServer:
                 # (None) only when an operand set is wider than its pool
                 # even after a reset.
                 parts = self._owner_operands(pairs, pos)
+                # Stratum routes the same call through the tier planner:
+                # resident leg fused as before, warm/cold legs streamed
+                # and merged exactly. Without it, plane folds directly.
+                folder = (
+                    self._stratum.fold_groups
+                    if self._stratum is not None
+                    else self._resident.fold_groups
+                )
                 with tracer.span("proxy.resident_fold", k=len(operands),
                                  shards=len(parts),
                                  backend=self.backend.name):
                     result = await asyncio.to_thread(
-                        self._resident.fold_groups, parts, modulus,
-                        self._plane_tenant(),
+                        folder, parts, modulus, self._plane_tenant(),
                     )
             if result is not None:
                 return Response.json(J.value_result(str(result)))

@@ -70,6 +70,9 @@ STAGES = (
     "replica-apply",            # replica handler work (storage + sign)
     "ingest-queue-wait",        # sat in a TimedQueue before a drain
     "host-to-device-transfer",  # host limbs -> HBM rows
+    "tier-promote",             # Stratum warm/cold rows re-entering HBM
+    "tier-demote",              # Stratum eviction: HBM -> warm -> segments
+    "tier-cold-read",           # segment read + HMAC re-verify from disk
     "trace-compile",            # one-time jit trace+compile (cold call)
     "dispatch",                 # host-side dispatch orchestration
     "device-execute",           # on-device kernel time
@@ -96,6 +99,12 @@ def classify(name: str, *, root: bool = False) -> str:
         return "ingest-queue-wait"
     if name == "ingest.h2d":
         return "host-to-device-transfer"
+    if name == "tier.promote":
+        return "tier-promote"
+    if name == "tier.demote":
+        return "tier-demote"
+    if name == "tier.cold_read":
+        return "tier-cold-read"
     if name.startswith("replica.") or name.startswith("antientropy."):
         return "replica-apply"
     if name.startswith("kernel."):

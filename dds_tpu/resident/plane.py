@@ -173,6 +173,10 @@ class ResidentPlane:
         # tenant "" is the legacy/single-tenant stripe
         self._pools: dict[tuple[str, str, int], ResidentPool] = {}
         self._order: dict[str, int] = {}  # gid -> mesh slice index
+        # Stratum (dds_tpu/storage): when attached, every pool wires its
+        # spill/evict_rank to the tier hierarchy at creation — capacity
+        # overflow then demotes to the warm tier instead of resetting
+        self.tier_sink = None
         # queued (gid, cipher) write ingests; enqueue-timestamped so the
         # drain can attribute ingest-queue-wait, drops reason-labelled
         self._pending = TimedQueue("lodestone-ingest", maxlen=self.max_pending)
@@ -206,6 +210,8 @@ class ResidentPlane:
                     gid=(f"{gid}|{tenant}" if tenant else gid),
                     sharding=group_sharding(self.mesh, idx, self.axis),
                 )
+                if self.tier_sink is not None:
+                    self.tier_sink.wire_pool(key, p)
             return p
 
     # ----------------------------------------------------- write-path ingest
@@ -310,9 +316,20 @@ class ResidentPlane:
 
     def stats(self) -> dict:
         """Per-pool view for GET /health."""
+        import time as _time
+
         with self._lock:
             pools = dict(self._pools)
         pending = self._pending.depth()
+        # reset visibility (the silent fast-path loss): total resets and
+        # the age of the most recent one, surfaced so operators see a
+        # thrashing pool without scraping metrics or grepping logs
+        resets = sum(p.resets for p in pools.values())
+        last_ts = max(
+            (p._last_reset_ts for p in pools.values()
+             if p._last_reset_ts is not None),
+            default=None,
+        )
         return {
             "kernel": self.kernel,
             "mesh_devices": (
@@ -320,6 +337,12 @@ class ResidentPlane:
             ),
             "pending_ingest": pending,
             "dropped_pending": self._pending.dropped(),
+            "resets": resets,
+            "last_reset_age_s": (
+                round(_time.time() - last_ts, 1) if last_ts is not None
+                else None
+            ),
+            "tiered": self.tier_sink is not None,
             "pools": [
                 {"shard": gid or "-", "tenant": tenant or "-",
                  "modulus_bits": mod.bit_length(), **pool.stats()}

@@ -16,11 +16,18 @@ reads per aggregate — the pool only memoizes the transfer/limb-conversion
 of bytes the device has already seen, so a stale row cannot exist by
 construction; the quorum read decides WHICH ciphertexts fold.
 
-Capacity grows by doubling up to `max_rows`; beyond that the pool resets
-(entries re-ingest on demand) and bumps its `epoch`, invalidating every
-row-index memo minted against the old placement — simple, and an
+Capacity grows by doubling up to `max_rows`. Past that the behavior
+depends on whether a tier sink is wired (`spill`, set by Stratum —
+dds_tpu/storage): with one, the pool EVICTS its coldest rows to the
+warm tier (coldest-first order from `evict_rank`, the directory's
+decayed popularity) and keeps serving the fused fast path for the rows
+that stay — the fast path degrades gradually instead of cliff-dropping.
+Without a sink the legacy RESET remains (entries re-ingest on demand,
+`epoch` bumps, every row-index memo invalidates) — simple, and an
 aggregate after a reset pays exactly the one-time ingest cost again,
-never wrong results.
+never wrong results; the reset now also files a `resident_reset` flight
+incident and stamps `last_reset_ts` so /health surfaces the silent
+fast-path loss instead of burying it in a log line.
 
 Placement: `sharding` optionally pins the buffer device-side (a
 `NamedSharding` built by `parallel/mesh.group_sharding` maps group i to
@@ -64,6 +71,12 @@ class ResidentPool:
     max_rows: int = 1 << 20  # ~1 GiB of HBM at L=256
     gid: str = ""
     sharding: object = None  # jax Sharding pinning the buffer (None = default)
+    # Stratum tier sink (dds_tpu/storage): `spill` receives the evicted
+    # [(cipher, (L,) uint32 host row)] batch when capacity overflows;
+    # `evict_rank` orders candidate ciphers coldest-first (the tier
+    # directory's decayed popularity). Both None = legacy reset behavior.
+    spill: object = None
+    evict_rank: object = None
     _ctx: ModCtx = field(init=False, repr=False)
     _buf: object = field(init=False, repr=False)   # jnp (cap, L) uint32
     _index: dict[int, int] = field(init=False, repr=False)
@@ -83,6 +96,11 @@ class ResidentPool:
         self._idx_memo: tuple | None = None
         self._epoch = 0
         self._resets = 0
+        self._last_reset_ts: float | None = None
+        # rows evicted under the lock, delivered to `spill` after release
+        # (the sink may write to disk; holding the pool lock across an
+        # fsync would serialize concurrent folds on storage latency)
+        self._spill_out: list[list] = []
         # cumulative operand accounting (resident / ingested / direct):
         # feeds the plane's dds_resident_hit_ratio gauge without a metrics
         # round-trip
@@ -142,6 +160,10 @@ class ResidentPool:
             "bytes": self.nbytes(),
             "epoch": self._epoch,
             "resets": self._resets,
+            "last_reset_age_s": (
+                round(time.time() - self._last_reset_ts, 1)
+                if self._last_reset_ts is not None else None
+            ),
             "hit_ratio": (
                 round(self.hit_ratio(), 4)
                 if self.hit_ratio() is not None else None
@@ -150,13 +172,19 @@ class ResidentPool:
 
     # --------------------------------------------------------------- ingest
 
-    def _grow(self, need: int) -> None:
+    def _grow(self, need: int, protect=()) -> None:
         import jax.numpy as jnp
 
         cap = self.capacity
         while cap < need:
             cap *= 2
         if cap > self.max_rows:
+            if self.spill is not None:
+                # Stratum eviction-to-warm: demote the coldest resident
+                # rows instead of resetting — the counter stays frozen
+                # and the fused fast path degrades gradually
+                self._evict(need, protect)
+                return
             log.warning(
                 "resident pool %s over max_rows (%d > %d): resetting",
                 self.gid or "-", need, self.max_rows,
@@ -165,16 +193,139 @@ class ResidentPool:
             self._count = 0
             self._epoch += 1  # row indices changed: invalidate idx memos
             self._resets += 1
+            self._last_reset_ts = time.time()
             metrics.inc(
                 "dds_resident_resets_total", shard=self.gid or "-",
                 help="resident-pool capacity resets (entries re-ingest "
                      "on demand)",
             )
+            self._file_reset_incident(need)
             cap = max(self.initial_rows, min(cap, self.max_rows))
             self._buf = self._place_zeros(cap)
             return
         pad = jnp.zeros((cap - self.capacity, self._ctx.L), jnp.uint32)
         self._buf = self._place(jnp.concatenate([self._buf, pad], axis=0))
+
+    def _file_reset_incident(self, need: int) -> None:
+        """A capacity reset silently drops the fused fast path until the
+        working set re-ingests — incident-worthy, not just a log line.
+        Loop-aware like Chronoscope's exemplar capture: pool calls run on
+        worker threads (sync write is fine) but belt-and-braces for any
+        on-loop caller the blocking write dispatches supervised."""
+        import asyncio
+
+        from dds_tpu.obs.flight import flight
+
+        if not getattr(flight, "enabled", False):
+            return
+        info = {
+            "shard": self.gid or "-", "need": need,
+            "max_rows": self.max_rows, "resets": self._resets,
+        }
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            try:
+                flight.record("resident_reset", **info)
+            except Exception:  # noqa: BLE001 — telemetry never breaks ingest
+                log.exception("resident_reset incident write failed")
+            return
+        from dds_tpu.utils.tasks import supervised_task
+
+        supervised_task(
+            flight.record_async("resident_reset", **info),
+            name="resident.reset_incident",
+        )
+
+    def _evict(self, need: int, protect=()) -> None:
+        """Demote the coldest rows to the tier sink so `need` total rows
+        fit under `max_rows` (caller holds `_lock`). `protect` (the
+        operand set being ensured) is never evicted — evicting it would
+        re-inflate `missing` and loop; |distinct protect| <= max_rows is
+        guaranteed to fit because every non-protected row is evictable.
+        The spilled batch is queued and delivered OUTSIDE the lock."""
+        import jax.numpy as jnp
+
+        protect = set(protect)
+        if len(protect) > self.max_rows:
+            return  # aggregate wider than the pool: ensure() answers None
+        incoming = need - self._count
+        if incoming > self.max_rows:
+            return
+        evictable = [c for c in self._index if c not in protect]
+        # at least a quarter per wave: hysteresis against per-row thrash
+        evict_n = max(need - self.max_rows, (self._count + 3) // 4)
+        evict_n = min(evict_n, len(evictable))
+        if evict_n <= 0:
+            return
+        if self.evict_rank is not None:
+            try:
+                ranked = [c for c in self.evict_rank(evictable)
+                          if c in self._index and c not in protect]
+            except Exception:  # noqa: BLE001 — a sink bug must not lose rows
+                log.exception("evict_rank failed; falling back to FIFO")
+                ranked = evictable
+        else:
+            ranked = evictable
+        victims = list(dict.fromkeys(ranked))[:evict_n]
+        if len(victims) < evict_n:
+            seen = set(victims)
+            for c in evictable:
+                if c not in seen:
+                    victims.append(c)
+                    if len(victims) >= evict_n:
+                        break
+        vset = set(victims)
+        host = np.asarray(self._buf[: self._count])  # one D2H copy
+        spilled = [(c, host[self._index[c]].copy()) for c in victims]
+        survivors = [c for c in self._index if c not in vset]
+        cap = self.capacity
+        while cap < len(survivors) + incoming and cap < self.max_rows:
+            cap *= 2
+        cap = min(max(cap, self.initial_rows), self.max_rows)
+        newbuf = np.zeros((cap, self._ctx.L), np.uint32)
+        if survivors:
+            newbuf[: len(survivors)] = host[
+                [self._index[c] for c in survivors]
+            ]
+        self._buf = self._place(jnp.asarray(newbuf))
+        self._index = {c: i for i, c in enumerate(survivors)}
+        self._count = len(survivors)
+        self._epoch += 1  # row indices changed: invalidate idx memos
+        self._spill_out.append(spilled)
+        metrics.inc(
+            "dds_resident_evictions_total", len(victims),
+            shard=self.gid or "-",
+            help="rows evicted from resident pools to the warm tier "
+                 "(Stratum; replaces capacity resets)",
+        )
+        log.info(
+            "resident pool %s evicted %d cold rows to warm tier "
+            "(%d stay resident)",
+            self.gid or "-", len(victims), len(survivors),
+        )
+
+    def _flush_spill(self) -> None:
+        """Deliver queued evictions to the tier sink outside `_lock`."""
+        sink = self.spill
+        while True:
+            with self._lock:
+                if not self._spill_out:
+                    return
+                batch = self._spill_out.pop(0)
+            if sink is None:
+                continue
+            try:
+                sink(batch)
+            except Exception:  # noqa: BLE001 — sink bugs must not break folds
+                log.exception("tier spill sink failed (%d rows dropped "
+                              "back to lazy re-ingest)", len(batch))
+
+    def membership(self, cs: list[int]) -> list[bool]:
+        """Per-operand hot-tier residency, one lock round — the Stratum
+        planner's split primitive."""
+        with self._lock:
+            return [c in self._index for c in cs]
 
     def ensure(self, cs: list[int], pre: dict | None = None) -> np.ndarray | None:
         """Ingest any unseen ciphertexts; return row indices for all of cs.
@@ -191,7 +342,7 @@ class ResidentPool:
         missing = sorted({c for c in cs if c not in self._index})
         if missing:
             if self._count + len(missing) > self.capacity:
-                self._grow(self._count + len(missing))
+                self._grow(self._count + len(missing), protect=cs)
                 missing = sorted({c for c in cs if c not in self._index})
             if self._count + len(missing) > self.capacity:
                 return None  # wider than max_rows even when empty
@@ -225,9 +376,12 @@ class ResidentPool:
         pre = {c: converted[i] for i, c in enumerate(missing)}
         t_h2d = time.perf_counter()
         with self._lock:
-            before = self._count
+            missing_now = [c for c in missing if c not in self._index]
             self.ensure(missing, pre)
-            grew = self._count - before
+            # count placements, not the buffer delta: an eviction wave in
+            # the same ensure() can shrink _count while rows still land
+            grew = sum(1 for c in missing_now if c in self._index)
+        self._flush_spill()
         if grew:
             # Chronoscope's host-to-device-transfer stage + bytes-moved
             # accounting: each placed row is L limbs of 4 bytes on device
@@ -307,10 +461,14 @@ class ResidentPool:
             idx = self.ensure(cs, pre)
             if idx is None:
                 self._account(0, 0, len(cs))
-                return None
-            self._idx_memo = (cs, self._epoch, idx)
-            self._account(len(cs) - len(missing), len(missing), 0)
-            return self._buf, idx
+                out = None
+            else:
+                self._idx_memo = (cs, self._epoch, idx)
+                self._account(len(cs) - len(missing), len(missing), 0)
+                out = (self._buf, idx)
+        # deliver any eviction wave to the tier sink outside the lock
+        self._flush_spill()
+        return out
 
     def fold(self, cs: list[int]) -> int:
         """prod(cs) mod modulus, gathering resident rows on-device."""

@@ -446,6 +446,7 @@ async def launch(cfg: DDSConfig | None = None) -> Deployment:
             tenancy=cfg.tenancy,
             resident=cfg.resident,
             search=cfg.search,
+            storage=cfg.storage,
             heliograph=cfg.heliograph,
             ssl_server_context=ssl_server,
             ssl_client_context=ssl_client,
@@ -616,6 +617,7 @@ def proxy_config(cfg: DDSConfig, supervisor, ssl_server, ssl_client,
         tenancy=cfg.tenancy,
         resident=cfg.resident,
         search=cfg.search,
+        storage=cfg.storage,
         heliograph=cfg.heliograph,
         # operator reshape control (POST /_reshard, /_helmsman) — gated
         # exactly like the Meridian proxy role; without a reshard
@@ -768,6 +770,11 @@ async def _launch_constellation(cfg: DDSConfig, net, stoppables,
                 s.heliograph.unreachable_regions()
                 if s.heliograph is not None else set()
             )) if cfg.heliograph.enabled else None,
+            # Stratum: blended hot+warm tier occupancy — HBM-full now
+            # reads as pressure the controller can split away, instead
+            # of a silent pool reset the fleet never sees
+            pool_pressure=(lambda s=server: s.tier_pressure())
+            if cfg.storage.enabled else None,
         )
         if admission is not None:
             admission.subscribe(hm.on_admission)

@@ -37,6 +37,7 @@ round sees that key as stale and repairs it — never a wrong answer.
 
 from __future__ import annotations
 
+import logging
 import operator
 import threading
 
@@ -44,6 +45,8 @@ import numpy as np
 
 from dds_tpu.models.det import DetKey
 from dds_tpu.utils.queues import TimedQueue
+
+log = logging.getLogger("dds.search")
 
 _HOST_OPS = {
     "gt": operator.gt,
@@ -316,6 +319,11 @@ class SearchPlane:
         self.max_pending = max_pending
         self._ingested = 0
         self._invalidations = 0
+        # optional (keys, tenant) -> None popularity sink: Stratum wires
+        # `touch_keys` here so every selection's hit set warms those
+        # rows' fold ciphertexts in the tier directory (Zipf feed from
+        # the search path; pure dict math, loop-safe)
+        self.touch_sink = None
 
     def group(self, gid: str, tenant: str = "") -> GroupIndex:
         with self._lock:
@@ -327,6 +335,18 @@ class SearchPlane:
     def register_groups(self, gids) -> None:
         for gid in gids:
             self.group(gid)
+
+    def note_selected(self, keys, tenant: str = "") -> None:
+        """Report a query's selected keys to the tiered-storage
+        popularity feed, when one is wired. Best-effort: a sink failure
+        must never fail the query that fed it."""
+        sink = self.touch_sink
+        if sink is None or not keys:
+            return
+        try:
+            sink(keys, tenant)
+        except Exception:  # popularity is advisory, queries are not
+            log.debug("search touch sink failed", exc_info=True)
 
     def group_ids(self) -> list[str]:
         return sorted({gid for gid, _t in self._groups})

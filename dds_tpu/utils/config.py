@@ -367,6 +367,38 @@ class ResidentConfig:
 
 
 @dataclass
+class StorageConfig:
+    """Stratum tiered ciphertext storage (dds_tpu/storage): grows the
+    Lodestone resident plane downward into a three-tier hierarchy — HBM
+    pools (hot), a host-pinned numpy limb cache (warm), and an append-only
+    HMAC'd segment log on disk (cold, snapshot-v2 crash-safety). Pool
+    capacity overflow then EVICTS coldest-first instead of resetting, and
+    aggregates split into a resident-fused leg plus streamed-from-tier
+    legs merged bit-for-bit exactly. Requires `[resident]` enabled (the
+    hot tier IS the resident plane). Budgeting arithmetic and the
+    crash-recovery matrix live in DEPLOY.md "Tiered storage (Stratum)"."""
+
+    enabled: bool = False
+    # segment + manifest directory (created on first demotion/boot)
+    dir: str = "./stratum"
+    # warm-tier host budget: rows are L x 4 bytes (1 KiB at L=256), so
+    # 64 MiB holds ~65k demoted rows — one full default pool over again
+    warm_bytes: int = 64 << 20
+    # streamed-fold slice: rows per host->HBM transfer + device fold
+    chunk_rows: int = 256
+    # promotion: decayed touch score a warm/cold entry must clear to
+    # re-enter HBM, and the per-fold promotion cap (anti-thrash)
+    promote_score: float = 2.0
+    max_promote: int = 256
+    # popularity decay half-life (seconds) for the tier directory's EWMA
+    half_life: float = 60.0
+    # manifest generations kept (the snapshot keep-N discipline) and the
+    # live-segment count that triggers compaction
+    keep: int = 3
+    compact_segments: int = 8
+
+
+@dataclass
 class SearchConfig:
     """Spyglass device-resident encrypted search plane (dds_tpu/search):
     per-shard-group, per-column indexes over the DET (equality) and OPE
@@ -758,6 +790,7 @@ class DDSConfig:
     analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     resident: ResidentConfig = field(default_factory=ResidentConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
     search: SearchConfig = field(default_factory=SearchConfig)
     fabric: FabricConfig = field(default_factory=FabricConfig)
     helmsman: HelmsmanConfig = field(default_factory=HelmsmanConfig)
@@ -818,6 +851,7 @@ _SUBSECTIONS = {
     ("DDSConfig", "analytics"): AnalyticsConfig,
     ("DDSConfig", "admission"): AdmissionConfig,
     ("DDSConfig", "resident"): ResidentConfig,
+    ("DDSConfig", "storage"): StorageConfig,
     ("DDSConfig", "search"): SearchConfig,
     ("DDSConfig", "fabric"): FabricConfig,
     ("DDSConfig", "helmsman"): HelmsmanConfig,

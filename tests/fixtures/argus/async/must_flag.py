@@ -6,6 +6,7 @@ work the sanctioned way.
 """
 
 import asyncio
+import os
 import subprocess
 import threading
 import time
@@ -23,6 +24,7 @@ async def blocks_the_loop():
     time.sleep(0.1)                        # async.blocking-call
     subprocess.run(["true"])               # async.blocking-call
     data = open("/tmp/argus-fixture").read()   # async.blocking-call
+    os.fsync(4)                            # async.blocking-call
     flight.record("incident", detail=data)     # async.blocking-call
     return data
 

@@ -18,6 +18,24 @@ def read_fixture() -> str:
         return f.read()
 
 
+def append_segment(payload: bytes) -> None:
+    """Stratum-style durable append, sanctioned shape: the open + flush
+    + fsync sequence lives in a SYNC function that async callers reach
+    only through ``asyncio.to_thread`` — the fsync-before-rename
+    discipline never runs on the event loop."""
+    import os
+
+    with open("/tmp/argus-fixture.tmp", "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace("/tmp/argus-fixture.tmp", "/tmp/argus-fixture.seg")
+
+
+async def durable_append(payload: bytes) -> None:
+    await asyncio.to_thread(append_segment, payload)
+
+
 async def helper():
     await asyncio.sleep(0)
 

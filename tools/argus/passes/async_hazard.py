@@ -54,6 +54,10 @@ BLOCKING_SUFFIXES = {
     "subprocess.check_call": "blocks the loop; use asyncio.create_subprocess_exec",
     "subprocess.check_output": "blocks the loop; use asyncio.create_subprocess_exec",
     "os.system": "blocks the loop; use asyncio.create_subprocess_exec",
+    "os.fsync": "sync disk flush (the fsync-before-rename discipline is "
+                "worker-thread work); use asyncio.to_thread",
+    "os.fdatasync": "sync disk flush (the fsync-before-rename discipline "
+                    "is worker-thread work); use asyncio.to_thread",
     "flight.record": "threading.Lock + sync disk write on the fault path; "
                      "use flight.record_async",
 }
